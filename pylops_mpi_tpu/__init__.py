@@ -1,0 +1,28 @@
+"""pylops_mpi_tpu — TPU-native distributed linear operators and solvers.
+
+A ground-up rebuild of PyLops-MPI (reference ``pylops_mpi/__init__.py``)
+for TPU: one controller drives a :class:`jax.sharding.Mesh`; MPI/NCCL
+collectives become XLA ``psum``/``all_gather``/``all_to_all``/``ppermute``
+over ICI/DCN; solver loops run on device as ``lax.while_loop``s.
+"""
+
+from .parallel.partition import Partition, local_split
+from .parallel.mesh import (
+    make_mesh, make_mesh_2d, default_mesh, set_default_mesh, best_grid_2d,
+)
+from .distributedarray import DistributedArray
+from .stacked import StackedDistributedArray
+from .linearoperator import (
+    MPILinearOperator, LinearOperator, aslinearoperator, asmpilinearoperator,
+)
+from .ops.blockdiag import MPIBlockDiag, MPIStackedBlockDiag
+from .ops.stack import MPIVStack, MPIStackedVStack, MPIHStack
+from .solvers.basic import CG, CGLS, cg, cgls
+from .utils.dottest import dottest
+
+from . import ops
+from . import solvers
+from . import utils
+from . import parallel
+
+__version__ = "0.1.0"

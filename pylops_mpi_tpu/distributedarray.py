@@ -1,0 +1,659 @@
+"""DistributedArray: a mesh-sharded ndarray with the reference's semantics.
+
+TPU-native rebuild of ``pylops_mpi/DistributedArray.py`` (ref lines
+26-960). The reference is SPMD: every MPI rank owns one shard and all
+wire traffic is explicit (allreduce for ``dot``/``norm``, p2p for ghost
+cells, pairwise sendrecv for ``redistribute``). Here a single controller
+holds one :class:`jax.Array` laid out over a :class:`jax.sharding.Mesh`
+with a :class:`NamedSharding`; elementwise arithmetic, reductions and
+reshards are plain ``jnp`` ops whose collectives XLA's partitioner emits
+over ICI.
+
+**Physical layout.** XLA requires equal per-device shards, so the
+partition axis is always laid out as ``P`` blocks of ``s_phys`` rows:
+``s_phys = max(local sizes)``, zero-padded per shard when the logical
+split is uneven (exactly the pad-to-max strategy the reference's NCCL
+path uses for ragged allgathers, ``utils/_nccl.py:363-403``). In the
+common even case the physical and logical arrays coincide and no padding
+or masking exists anywhere on the hot path. Reductions apply static
+valid-masks derived from ``local_shapes`` metadata.
+
+Semantics preserved from the reference:
+
+- the :class:`Partition` placement model and balanced remainder split
+  (ref ``DistributedArray.py:26-71``), including user-specified ragged
+  ``local_shapes``;
+- ``to_dist`` / ``asarray`` scatter/gather (ref ``408-461``, ``371-406``);
+- arithmetic / ``dot`` / ``norm`` for all orders incl. 0 and ±inf
+  (ref ``588-808``);
+- ``mask`` sub-communicator groups: reductions per rank-group
+  (ref ``74-100``) — realised as static segment reductions over the
+  shard blocks rather than ``Comm.Split``;
+- shard-major ``ravel`` (ref ``847-875``), ``add_ghost_cells``
+  (ref ``877-954``) and ``redistribute`` (ref ``463-522``).
+
+Deliberate semantic departures (documented, not bugs):
+
+- ``BROADCAST`` vs ``UNSAFE_BROADCAST`` coincide: a replicated JAX array
+  cannot drift between devices, so rank-0 write-resync
+  (ref ``207-220``) has no analog.
+- reductions return results in the array's real dtype (f64 only under
+  ``jax_enable_x64``) instead of always-f64.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .parallel.mesh import default_mesh, axis_sharding, replicated_sharding
+from .parallel.partition import Partition, local_split
+
+__all__ = ["DistributedArray", "Partition", "local_split"]
+
+
+NDArrayLike = Union[np.ndarray, jax.Array]
+
+
+def _sorted_colors(mask: Sequence[int]) -> List[Any]:
+    seen = []
+    for c in mask:
+        if c not in seen:
+            seen.append(c)
+    return sorted(seen)
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+class DistributedArray:
+    """Mesh-sharded array (ref ``pylops_mpi/DistributedArray.py:74-960``).
+
+    Parameters
+    ----------
+    global_shape : tuple or int
+        Logical global shape.
+    mesh : jax.sharding.Mesh, optional
+        1-D device mesh (defaults to the process-wide mesh over all
+        devices). Plays the role of ``base_comm``.
+    partition : Partition
+        Placement policy (SCATTER / BROADCAST / UNSAFE_BROADCAST).
+    axis : int
+        Sharded dimension for SCATTER.
+    local_shapes : list of tuples, optional
+        Logical per-shard shapes (defaults to the balanced split,
+        ref ``DistributedArray.py:42-71``). May be ragged along ``axis``.
+    mask : list of int, optional
+        Group color per shard; ``dot``/``norm`` reduce within groups
+        (ref ``DistributedArray.py:74-100``).
+    dtype : dtype, optional
+    """
+
+    def __init__(self, global_shape, mesh: Optional[Mesh] = None,
+                 partition: Partition = Partition.SCATTER, axis: int = 0,
+                 local_shapes: Optional[Sequence[Tuple[int, ...]]] = None,
+                 mask: Optional[Sequence[int]] = None,
+                 dtype=None):
+        if isinstance(global_shape, (int, np.integer)):
+            global_shape = (int(global_shape),)
+        global_shape = tuple(int(s) for s in global_shape)
+        if partition not in Partition:
+            raise ValueError(f"Should be one of {[p for p in Partition]}")
+        if axis < 0:
+            axis += len(global_shape)
+        if partition == Partition.SCATTER and not (0 <= axis < len(global_shape)):
+            raise IndexError(f"axis {axis} out of range for shape {global_shape}")
+        self._mesh = mesh if mesh is not None else default_mesh()
+        self._n_shards = int(self._mesh.devices.size)
+        self._partition = partition
+        self._axis = int(axis)
+        self._global_shape = global_shape
+        if local_shapes is None:
+            local_shapes = local_split(global_shape, self._n_shards, partition, axis)
+        else:
+            local_shapes = tuple(tuple(int(v) for v in np.atleast_1d(s)) for s in local_shapes)
+            if len(local_shapes) != self._n_shards:
+                raise ValueError(f"need {self._n_shards} local shapes, got {len(local_shapes)}")
+            if partition == Partition.SCATTER:
+                tot = sum(s[axis] for s in local_shapes)
+                if tot != global_shape[axis]:
+                    raise ValueError(
+                        f"local shapes sum to {tot} != global dim {global_shape[axis]}")
+        self._local_shapes = local_shapes
+        if mask is not None:
+            mask = tuple(mask)
+            if len(mask) != self._n_shards:
+                raise ValueError(f"mask must have {self._n_shards} entries")
+        self._mask = mask
+        dtype = jnp.zeros(0, dtype=dtype).dtype if dtype is not None else jnp.zeros(0).dtype
+        self._arr = lax.with_sharding_constraint(
+            jnp.zeros(self._phys_shape(), dtype=dtype), self._sharding())
+
+    # -------------------------------------------------------------- layout
+    @property
+    def _axis_sizes(self) -> Tuple[int, ...]:
+        """Logical per-shard size along the partition axis."""
+        return tuple(s[self._axis] for s in self._local_shapes)
+
+    @property
+    def _s_phys(self) -> int:
+        return max(self._axis_sizes) if self._axis_sizes else 0
+
+    @property
+    def _even(self) -> bool:
+        """True when the logical split is the uniform one (physical ==
+        logical, no padding anywhere)."""
+        sizes = self._axis_sizes
+        return self._partition != Partition.SCATTER or len(set(sizes)) == 1
+
+    def _phys_shape(self) -> Tuple[int, ...]:
+        if self._partition != Partition.SCATTER:
+            return self._global_shape
+        shp = list(self._global_shape)
+        shp[self._axis] = self._n_shards * self._s_phys
+        return tuple(shp)
+
+    def _sharding(self) -> NamedSharding:
+        if self._partition == Partition.SCATTER:
+            return axis_sharding(self._mesh, len(self._global_shape), self._axis)
+        return replicated_sharding(self._mesh)
+
+    def _place(self, arr: jax.Array) -> jax.Array:
+        """Pin physical placement (constraint under trace, device_put when
+        concrete)."""
+        sh = self._sharding()
+        if _is_tracer(arr):
+            return lax.with_sharding_constraint(arr, sh)
+        return jax.device_put(arr, sh)
+
+    def _from_global(self, garr: jax.Array) -> jax.Array:
+        """Logical global → physical (pad each shard to ``s_phys``).
+        Static-shape slicing, jit-safe."""
+        if self._even:
+            return garr
+        sizes = self._axis_sizes
+        sp = self._s_phys
+        offs = np.concatenate([[0], np.cumsum(sizes)])
+        parts = []
+        for i in range(self._n_shards):
+            idx = [slice(None)] * self.ndim
+            idx[self._axis] = slice(int(offs[i]), int(offs[i + 1]))
+            blk = garr[tuple(idx)]
+            pad = sp - sizes[i]
+            if pad:
+                padw = [(0, 0)] * self.ndim
+                padw[self._axis] = (0, pad)
+                blk = jnp.pad(blk, padw)
+            parts.append(blk)
+        return jnp.concatenate(parts, axis=self._axis)
+
+    def _global(self) -> jax.Array:
+        """Physical → logical global (strip padding). Jit-safe."""
+        if self._even:
+            return self._arr
+        sp = self._s_phys
+        parts = []
+        for i, n in enumerate(self._axis_sizes):
+            idx = [slice(None)] * self.ndim
+            idx[self._axis] = slice(i * sp, i * sp + n)
+            parts.append(self._arr[tuple(idx)])
+        return jnp.concatenate(parts, axis=self._axis)
+
+    def _valid_mask_blocks(self) -> Optional[np.ndarray]:
+        """(P, s_phys) bool mask of logically-valid rows; None if even."""
+        if self._even:
+            return None
+        sizes = np.asarray(self._axis_sizes)
+        return np.arange(self._s_phys)[None, :] < sizes[:, None]
+
+    def _valid_phys_mask(self) -> jax.Array:
+        """Bool mask over the physical array marking logically-valid
+        entries (broadcast along non-partition dims)."""
+        vm = self._valid_mask_blocks()
+        shape = [1] * self.ndim
+        shape[self._axis] = self._n_shards * self._s_phys
+        return jnp.asarray(vm.reshape(-1)).reshape(shape)
+
+    @classmethod
+    def _wrap(cls, arr: jax.Array, like: "DistributedArray", *,
+              partition=None, axis=None, local_shapes=None, mask=None,
+              global_shape=None, keep_mask: bool = True) -> "DistributedArray":
+        """Internal jit-safe constructor from a *physical* array."""
+        out = cls.__new__(cls)
+        out._mesh = like._mesh
+        out._n_shards = like._n_shards
+        out._partition = partition if partition is not None else like._partition
+        out._axis = axis if axis is not None else like._axis
+        out._global_shape = tuple(global_shape) if global_shape is not None else like._global_shape
+        out._local_shapes = tuple(tuple(s) for s in local_shapes) if local_shapes is not None \
+            else like._local_shapes
+        out._mask = mask if mask is not None else (like._mask if keep_mask else None)
+        out._arr = arr
+        return out
+
+    # ---------------------------------------------------------- properties
+    @property
+    def global_shape(self) -> Tuple[int, ...]:
+        return self._global_shape
+
+    @property
+    def local_shapes(self) -> Tuple[Tuple[int, ...], ...]:
+        return self._local_shapes
+
+    @property
+    def local_shape(self) -> Tuple[int, ...]:
+        # shard-0 logical shape (the reference reports the calling rank's)
+        return self._local_shapes[0]
+
+    @property
+    def partition(self) -> Partition:
+        return self._partition
+
+    @property
+    def axis(self) -> int:
+        return self._axis
+
+    @property
+    def mask(self):
+        return self._mask
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def n_shards(self) -> int:
+        return self._n_shards
+
+    @property
+    def dtype(self):
+        return self._arr.dtype
+
+    @property
+    def ndim(self) -> int:
+        return len(self._global_shape)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._global_shape))
+
+    @property
+    def array(self) -> jax.Array:
+        """The logical global (sharded) jax.Array."""
+        return self._global()
+
+    @property
+    def engine(self) -> str:
+        return "jax"
+
+    # ------------------------------------------------------ create/gather
+    @classmethod
+    def to_dist(cls, x: NDArrayLike, mesh: Optional[Mesh] = None,
+                partition: Partition = Partition.SCATTER, axis: int = 0,
+                local_shapes=None, mask=None) -> "DistributedArray":
+        """Scatter a global array over the mesh
+        (ref ``DistributedArray.py:408-461``; there every rank holds the
+        full ``x`` and slices its shard — here the controller places it
+        once with ``jax.device_put``)."""
+        x = jnp.asarray(x)
+        out = cls(global_shape=x.shape, mesh=mesh, partition=partition,
+                  axis=axis, local_shapes=local_shapes, mask=mask,
+                  dtype=x.dtype)
+        out._arr = out._place(out._from_global(x))
+        return out
+
+    def asarray(self) -> np.ndarray:
+        """Gather the global array to host
+        (ref ``DistributedArray.py:371-406``)."""
+        return np.asarray(jax.device_get(self._global()))
+
+    def local_arrays(self) -> List[np.ndarray]:
+        """Per-shard views under the logical split — debug/parity helper
+        standing in for the reference's per-rank ``local_array``."""
+        if self._partition != Partition.SCATTER:
+            g = self.asarray()
+            return [g.copy() for _ in range(self._n_shards)]
+        phys = np.asarray(jax.device_get(self._arr))
+        sp = self._s_phys
+        out = []
+        for i, n in enumerate(self._axis_sizes):
+            idx = [slice(None)] * self.ndim
+            idx[self._axis] = slice(i * sp, i * sp + n)
+            out.append(phys[tuple(idx)])
+        return out
+
+    # --------------------------------------------------------- get / set
+    def __getitem__(self, key):
+        return self._global()[key]
+
+    def __setitem__(self, key, value):
+        """Functional update on the logical global view. The reference's
+        per-rank ``arr[:] = local`` + rank-0 re-broadcast
+        (ref ``DistributedArray.py:207-220``) has no analog — there is a
+        single consistent value."""
+        if key == slice(None, None, None):
+            v = jnp.broadcast_to(jnp.asarray(value, dtype=self.dtype),
+                                 self._global_shape)
+            self._arr = self._place(self._from_global(v))
+        else:
+            g = self._global().at[key].set(value)
+            self._arr = self._place(self._from_global(g))
+
+    def fill(self, value) -> None:
+        self[:] = value
+
+    # --------------------------------------------------------- arithmetic
+    def _check_compat(self, other: "DistributedArray") -> None:
+        if self._global_shape != other._global_shape:
+            raise ValueError(
+                f"Global shape mismatch {self._global_shape} != {other._global_shape}")
+        if self._partition != other._partition:
+            raise ValueError(
+                f"Partition mismatch {self._partition} != {other._partition}")
+        if self._mask != other._mask:
+            raise ValueError("Mask mismatch")
+
+    def _group_ids_per_shard(self) -> np.ndarray:
+        colors = _sorted_colors(self._mask)
+        cmap = {c: i for i, c in enumerate(colors)}
+        return np.asarray([cmap[c] for c in self._mask])
+
+    def _expand_group_scalars(self, s: jax.Array) -> jax.Array:
+        """Broadcast a (ngroups,) vector of per-group scalars across the
+        physical partition axis, constant within each shard's group —
+        the one-controller analog of each rank using its own group's
+        reduction result."""
+        per_shard = s[jnp.asarray(self._group_ids_per_shard())]      # (P,)
+        per_index = jnp.repeat(per_shard, self._s_phys,
+                               total_repeat_length=self._n_shards * self._s_phys)
+        shape = [1] * self.ndim
+        shape[self._axis] = per_index.shape[0]
+        return per_index.reshape(shape)
+
+    def _coerce_operand(self, x):
+        if isinstance(x, DistributedArray):
+            self._check_compat(x)
+            if x._axis_sizes != self._axis_sizes:
+                raise ValueError("local shape mismatch")
+            return x._arr
+        if isinstance(x, (jax.Array, np.ndarray)) and np.ndim(x) == 1 \
+                and self._mask is not None \
+                and self._partition == Partition.SCATTER \
+                and x.shape[0] == len(_sorted_colors(self._mask)) \
+                and x.shape != self._global_shape:
+            # per-group scalars from a masked dot/norm
+            return self._expand_group_scalars(jnp.asarray(x))
+        return x
+
+    def add(self, x):
+        return DistributedArray._wrap(self._arr + self._coerce_operand(x), self)
+
+    def iadd(self, x):
+        self._arr = self._arr + self._coerce_operand(x)
+        return self
+
+    def multiply(self, x):
+        return DistributedArray._wrap(self._arr * self._coerce_operand(x), self)
+
+    def __add__(self, x):
+        return self.add(x)
+
+    def __radd__(self, x):
+        return self.add(x)
+
+    def __iadd__(self, x):
+        return self.iadd(x)
+
+    def __sub__(self, x):
+        return DistributedArray._wrap(self._arr - self._coerce_operand(x), self)
+
+    def __rsub__(self, x):
+        return DistributedArray._wrap(self._coerce_operand(x) - self._arr, self)
+
+    def __isub__(self, x):
+        self._arr = self._arr - self._coerce_operand(x)
+        return self
+
+    def __mul__(self, x):
+        return self.multiply(x)
+
+    def __rmul__(self, x):
+        return self.multiply(x)
+
+    def __truediv__(self, x):
+        if self._even:
+            return DistributedArray._wrap(self._arr / self._coerce_operand(x), self)
+        # guard 0/0 only in the pad region (valid zeros must still -> inf/nan)
+        num, den = self._arr, self._coerce_operand(x)
+        vm = self._valid_phys_mask()
+        out = jnp.where(vm, num / jnp.where(vm, den, 1), 0)
+        return DistributedArray._wrap(out, self)
+
+    def __neg__(self):
+        return DistributedArray._wrap(-self._arr, self)
+
+    # --------------------------------------------------------- reductions
+    def _shard_partials(self, z: jax.Array, op: str, fill) -> jax.Array:
+        """Reduce a physical array to one partial per shard: reshape the
+        partition axis into (P, s_phys) blocks, mask padding, reduce
+        everything but the shard axis."""
+        zb = jnp.moveaxis(z, self._axis, 0)
+        zb = zb.reshape((self._n_shards, self._s_phys) + zb.shape[1:])
+        vm = self._valid_mask_blocks()
+        if vm is not None:
+            mshape = (self._n_shards, self._s_phys) + (1,) * (zb.ndim - 2)
+            zb = jnp.where(jnp.asarray(vm).reshape(mshape), zb, fill)
+        red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
+        return red(zb.reshape(self._n_shards, -1), axis=1)
+
+    def _reduce(self, z: jax.Array, op: str, fill=0) -> jax.Array:
+        """Full or per-group reduction of a physical elementwise array."""
+        grouped = self._mask is not None and self._partition == Partition.SCATTER
+        if not grouped and self._even:
+            red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
+            return red(z)
+        partials = self._shard_partials(z, op, fill)                  # (P,)
+        if not grouped:
+            red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
+            return red(partials)
+        gid = jnp.asarray(self._group_ids_per_shard())
+        ngroups = len(_sorted_colors(self._mask))
+        f = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+             "min": jax.ops.segment_min}[op]
+        return f(partials, gid, num_segments=ngroups)
+
+    def dot(self, y: "DistributedArray", vdot: bool = False) -> jax.Array:
+        """Distributed dot product (ref ``DistributedArray.py:655-687``):
+        flatten, multiply, reduce — the reference's explicit allreduce
+        over the sub-communicator becomes a (possibly segmented) sum the
+        partitioner lowers to ``psum``. With a ``mask``, returns the
+        vector of per-group scalars (each reference rank sees only its
+        own group's value; here all groups are visible at once)."""
+        self._check_compat(y)
+        a = jnp.conj(self._arr) if vdot else self._arr
+        z = a * y._arr
+        if self._partition != Partition.SCATTER:
+            # BROADCAST ignores mask, as the reference's to_dist round-trip
+            # in dot does (ref DistributedArray.py:678-682)
+            return jnp.sum(z)
+        return self._reduce(z, "sum")
+
+    def _vector_norm_flat(self, ord=None) -> jax.Array:
+        """Whole-array vector norm, optionally per mask-group
+        (ref ``_compute_vector_norm``, ``DistributedArray.py:689-759``)."""
+        ord = 2 if ord is None else ord
+        if ord in ("fro", "nuc"):
+            raise ValueError(f"norm-{ord} not possible for vectors")
+        x = self._arr
+        if self._partition != Partition.SCATTER:
+            x2 = jnp.abs(x)
+            if ord == 0:
+                return jnp.count_nonzero(x).astype(x2.dtype)
+            if ord == np.inf:
+                return jnp.max(x2)
+            if ord == -np.inf:
+                return jnp.min(x2)
+            return jnp.sum(x2 ** ord) ** (1.0 / ord)
+        if ord == 0:
+            return self._reduce((x != 0).astype(jnp.abs(x).dtype), "sum")
+        if ord == np.inf:
+            return self._reduce(jnp.abs(x), "max", fill=-np.inf)
+        if ord == -np.inf:
+            return self._reduce(jnp.abs(x), "min", fill=np.inf)
+        return self._reduce(jnp.abs(x) ** ord, "sum") ** (1.0 / ord)
+
+    def norm(self, ord=None, axis: Optional[int] = None) -> jax.Array:
+        """Distributed ``numpy.linalg.norm``
+        (ref ``DistributedArray.py:775-808``). ``axis=None`` flattens;
+        ``axis=k`` computes vector norms along ``k`` (the distinction the
+        reference draws between the sharded and local axes dissolves —
+        XLA partitions either)."""
+        if axis is None:
+            return self._vector_norm_flat(ord)
+        if axis >= self.ndim:
+            raise ValueError(f"axis={axis} out of range for ndim={self.ndim}")
+        return jnp.linalg.norm(self._global(), ord=ord, axis=axis)
+
+    # ------------------------------------------------------------ algebra
+    def conj(self) -> "DistributedArray":
+        return DistributedArray._wrap(jnp.conj(self._arr), self)
+
+    def copy(self) -> "DistributedArray":
+        return DistributedArray._wrap(self._arr + 0, self)
+
+    def zeros_like(self) -> "DistributedArray":
+        return DistributedArray._wrap(jnp.zeros_like(self._arr), self)
+
+    def empty_like(self) -> "DistributedArray":
+        return self.zeros_like()
+
+    def ravel(self, order: str = "C") -> "DistributedArray":
+        """Shard-major flatten (ref ``DistributedArray.py:847-875``): the
+        result is the concatenation of each shard's C-order ravel —
+        identical to the global ravel when ``axis == 0``, a shard
+        permutation of it otherwise, exactly as in the reference."""
+        if order not in ("C", "K", "A"):
+            raise NotImplementedError("only C-order ravel is supported")
+        new_locals = tuple((int(np.prod(s)),) for s in self._local_shapes)
+        if self._partition != Partition.SCATTER:
+            arr = self._arr.reshape(-1)
+            return DistributedArray._wrap(arr, self, axis=0,
+                                          global_shape=(self.size,),
+                                          local_shapes=new_locals)
+        if self._axis == 0 and self.ndim == 1:
+            return DistributedArray._wrap(self._arr, self,
+                                          global_shape=(self.size,),
+                                          local_shapes=new_locals)
+        if self._axis == 0 and self._even:
+            # physical C-order ravel is already the shard-major flatten
+            out = DistributedArray._wrap(
+                self._arr.reshape(-1), self, axis=0,
+                global_shape=(self.size,), local_shapes=new_locals)
+            out._arr = out._place(out._arr)
+            return out
+        # general: concatenate per-shard ravels, then re-place
+        shards = []
+        sp = self._s_phys
+        for i, n in enumerate(self._axis_sizes):
+            idx = [slice(None)] * self.ndim
+            idx[self._axis] = slice(i * sp, i * sp + n)
+            shards.append(self._arr[tuple(idx)].reshape(-1))
+        g = jnp.concatenate(shards)
+        out = DistributedArray._wrap(g, self, axis=0,
+                                     global_shape=(self.size,),
+                                     local_shapes=new_locals)
+        out._arr = out._place(out._from_global(g))
+        return out
+
+    # ----------------------------------------------------- redistribution
+    def redistribute(self, axis: int) -> "DistributedArray":
+        """Change the sharded axis — the all-to-all pattern of
+        ref ``DistributedArray.py:463-522``, realised as a resharding
+        placement whose collective XLA schedules."""
+        if self._partition != Partition.SCATTER:
+            raise ValueError("redistribute only applies to SCATTER arrays")
+        if axis == self._axis:
+            return self.copy()
+        out = DistributedArray._wrap(
+            None, self, axis=axis,
+            local_shapes=local_split(self._global_shape, self._n_shards,
+                                     Partition.SCATTER, axis))
+        out._arr = out._place(out._from_global(self._global()))
+        return out
+
+    def to_partition(self, partition: Partition,
+                     axis: Optional[int] = None) -> "DistributedArray":
+        """Convert between BROADCAST and SCATTER placements (the idiom at
+        ref ``FirstDerivative.py:130-131``)."""
+        axis = self._axis if axis is None else axis
+        out = DistributedArray._wrap(
+            None, self, partition=partition, axis=axis,
+            local_shapes=local_split(self._global_shape, self._n_shards,
+                                     partition, axis))
+        out._arr = out._place(out._from_global(self._global()))
+        return out
+
+    # -------------------------------------------------------- ghost cells
+    def add_ghost_cells(self, cells_front: Optional[int] = None,
+                        cells_back: Optional[int] = None) -> List[jax.Array]:
+        """Per-shard arrays extended with neighbour rows
+        (ref ``DistributedArray.py:877-954``, where this is a p2p
+        Send/Recv chain). Returns the list of logically-ghosted shards;
+        shard 0 gets no front ghost and shard P-1 no back ghost, exactly
+        as the reference. Provided for API parity and tests — the hot
+        stencil path uses :mod:`ops.derivatives`' fused kernels instead."""
+        front = int(cells_front) if cells_front else 0
+        back = int(cells_back) if cells_back else 0
+        sizes = self._axis_sizes
+        offs = np.concatenate([[0], np.cumsum(sizes)])
+        g = self._global()
+        out = []
+        for i in range(self._n_shards):
+            if i > 0 and front > sizes[i - 1]:
+                raise ValueError(
+                    f"Local shape {sizes[i - 1]} along axis={self._axis} "
+                    f"must be >= ghost width {front}")
+            if i < self._n_shards - 1 and back > sizes[i + 1]:
+                raise ValueError(
+                    f"Local shape {sizes[i + 1]} along axis={self._axis} "
+                    f"must be >= ghost width {back}")
+            lo = max(0, int(offs[i]) - (front if i > 0 else 0))
+            hi = min(self._global_shape[self._axis],
+                     int(offs[i + 1]) + (back if i < self._n_shards - 1 else 0))
+            idx = [slice(None)] * self.ndim
+            idx[self._axis] = slice(lo, hi)
+            out.append(g[tuple(idx)])
+        return out
+
+    # ------------------------------------------------------------- pytree
+    def tree_flatten(self):
+        aux = (self._mesh, self._partition, self._axis, self._global_shape,
+               self._local_shapes, self._mask)
+        return (self._arr,), aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        out = cls.__new__(cls)
+        (out._mesh, out._partition, out._axis, out._global_shape,
+         out._local_shapes, out._mask) = aux
+        out._n_shards = int(out._mesh.devices.size)
+        out._arr = children[0]
+        return out
+
+    def __repr__(self):
+        return (f"<DistributedArray global_shape={self._global_shape}, "
+                f"partition={self._partition.name}, axis={self._axis}, "
+                f"dtype={self.dtype}, devices={self._n_shards}>")
+
+
+jax.tree_util.register_pytree_node(
+    DistributedArray,
+    lambda x: x.tree_flatten(),
+    DistributedArray.tree_unflatten,
+)

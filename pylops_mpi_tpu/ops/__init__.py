@@ -1,0 +1,3 @@
+from . import local
+from .blockdiag import MPIBlockDiag, MPIStackedBlockDiag
+from .stack import MPIVStack, MPIStackedVStack, MPIHStack

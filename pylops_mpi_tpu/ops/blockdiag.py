@@ -1,0 +1,147 @@
+"""Block-diagonal distributed operators.
+
+Rebuild of ``pylops_mpi/basicoperators/BlockDiag.py:16-188``. In the
+reference each MPI rank supplies its own list of local pylops operators
+and applies them to its shard — embarrassingly parallel, no comm in
+apply. Here the controller receives the *full* list of local operators,
+assigns contiguous chunks to shards (one list per shard, exactly the
+reference's layout), and the apply slices the sharded flat vector at
+static offsets so XLA keeps each block's GEMM on the device owning it.
+
+A fast path batches homogeneous blocks (same local shape) into a single
+leading-axis-sharded ``vmap`` — one big MXU-friendly batched GEMM instead
+of P small ones.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..distributedarray import DistributedArray, Partition
+from ..stacked import StackedDistributedArray
+from ..linearoperator import MPILinearOperator
+from .local import LocalOperator, MatrixMult
+
+__all__ = ["MPIBlockDiag", "MPIStackedBlockDiag"]
+
+
+def _chunk_ops(ops: Sequence, n_shards: int) -> List[List]:
+    """Assign operators to shards: contiguous balanced chunks (first
+    ``len(ops) % P`` shards get one extra), mirroring the reference's
+    one-list-per-rank layout under the balanced split rule."""
+    n = len(ops)
+    base, rem = divmod(n, n_shards)
+    chunks, off = [], 0
+    for i in range(n_shards):
+        c = base + (1 if i < rem else 0)
+        chunks.append(list(ops[off:off + c]))
+        off += c
+    return chunks
+
+
+class MPIBlockDiag(MPILinearOperator):
+    """Distributed block-diagonal operator
+    (ref ``basicoperators/BlockDiag.py:16-144``).
+
+    Parameters
+    ----------
+    ops : list of LocalOperator
+        All diagonal blocks (the concatenation of every rank's list in
+        the reference API).
+    mask : list of int, optional
+        Shard-group coloring; carried onto input/output arrays so their
+        reductions group exactly as the reference's sub-communicators do.
+    """
+
+    def __init__(self, ops: Sequence[LocalOperator],
+                 mask: Optional[Sequence[int]] = None,
+                 mesh=None, dtype=None):
+        self.ops = list(ops)
+        self.mask = tuple(mask) if mask is not None else None
+        from ..parallel.mesh import default_mesh
+        self.mesh = mesh if mesh is not None else default_mesh()
+        n_shards = int(self.mesh.devices.size)
+        self.chunks = _chunk_ops(self.ops, n_shards)
+        nops = np.asarray([op.shape[0] for op in self.ops])
+        mops = np.asarray([op.shape[1] for op in self.ops])
+        self.nops, self.mops = nops, mops
+        # per-shard logical shapes (what the reference gathers at
+        # construction, ref BlockDiag.py:106-120)
+        self.local_shapes_n = tuple(
+            (int(sum(op.shape[0] for op in c)),) for c in self.chunks)
+        self.local_shapes_m = tuple(
+            (int(sum(op.shape[1] for op in c)),) for c in self.chunks)
+        shape = (int(nops.sum()), int(mops.sum()))
+        dtype = dtype or np.result_type(*[op.dtype for op in self.ops])
+        super().__init__(shape=shape, dtype=dtype)
+        self._batched = self._try_batch()
+
+    def _try_batch(self):
+        """Homogeneous MatrixMult blocks → stacked batched GEMM."""
+        if not all(isinstance(op, MatrixMult) and not op.otherdims
+                   for op in self.ops):
+            return None
+        shapes = {op.A.shape for op in self.ops}
+        if len(shapes) != 1 or len(self.ops) % int(self.mesh.devices.size) != 0:
+            return None
+        A = jnp.stack([op.A for op in self.ops])  # (nblk, m, n)
+        from ..parallel.mesh import axis_sharding
+        return jax.device_put(A, axis_sharding(self.mesh, 3, 0))
+
+    def _apply(self, x: DistributedArray, forward: bool) -> DistributedArray:
+        sizes_in = self.mops if forward else self.nops
+        sizes_out = self.nops if forward else self.mops
+        locals_out = self.local_shapes_n if forward else self.local_shapes_m
+        y_shape = self.shape[0] if forward else self.shape[1]
+        if self._batched is not None:
+            A = self._batched
+            nblk, m, n = A.shape
+            X = x.array.reshape(nblk, n if forward else m)
+            if forward:
+                Y = jnp.einsum("bmn,bn->bm", A, X)
+            else:
+                Y = jnp.einsum("bnm,bn->bm", A.conj(), X)
+            arr = Y.ravel()
+        else:
+            offs = np.concatenate([[0], np.cumsum(sizes_in)])
+            parts = []
+            for op, lo, hi in zip(self.ops, offs[:-1], offs[1:]):
+                xb = x.array[int(lo):int(hi)]
+                parts.append(op.matvec(xb) if forward else op.rmatvec(xb))
+            arr = jnp.concatenate(parts)
+        y = DistributedArray(global_shape=y_shape, mesh=self.mesh,
+                             partition=x.partition, axis=0,
+                             local_shapes=locals_out, mask=self.mask,
+                             dtype=arr.dtype)
+        y[:] = arr
+        return y
+
+    def _matvec(self, x: DistributedArray) -> DistributedArray:
+        return self._apply(x, forward=True)
+
+    def _rmatvec(self, x: DistributedArray) -> DistributedArray:
+        return self._apply(x, forward=False)
+
+
+class MPIStackedBlockDiag(MPILinearOperator):
+    """Diagonal stack of distributed operators acting on a
+    StackedDistributedArray (ref ``BlockDiag.py:147-188``)."""
+
+    def __init__(self, ops: Sequence[MPILinearOperator]):
+        self.ops = list(ops)
+        shape = (int(sum(op.shape[0] for op in ops)),
+                 int(sum(op.shape[1] for op in ops)))
+        dtype = np.result_type(*[op.dtype for op in ops])
+        super().__init__(shape=shape, dtype=dtype)
+
+    def _matvec(self, x: StackedDistributedArray) -> StackedDistributedArray:
+        return StackedDistributedArray(
+            [op.matvec(d) for op, d in zip(self.ops, x.distarrays)])
+
+    def _rmatvec(self, x: StackedDistributedArray) -> StackedDistributedArray:
+        return StackedDistributedArray(
+            [op.rmatvec(d) for op, d in zip(self.ops, x.distarrays)])

@@ -1,0 +1,118 @@
+"""Vertical / horizontal stacking of distributed operators.
+
+Rebuild of ``pylops_mpi/basicoperators/VStack.py:21-203`` and
+``HStack.py:11-106``. Reference comm pattern: forward takes a BROADCAST
+model, every rank computes its own row-block (no comm), output is
+SCATTER; adjoint computes per-rank partials ``Lᵢᴴ xᵢ`` then
+sum-allreduces into a BROADCAST result (ref ``VStack.py:135-150``).
+Here the partials are a static slice-apply chain whose final sum the XLA
+partitioner lowers to the same allreduce over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..distributedarray import DistributedArray, Partition
+from ..stacked import StackedDistributedArray
+from ..linearoperator import MPILinearOperator
+from .local import LocalOperator
+
+__all__ = ["MPIVStack", "MPIStackedVStack", "MPIHStack"]
+
+
+class MPIVStack(MPILinearOperator):
+    """Distributed vertical stack (ref ``basicoperators/VStack.py:21-203``).
+
+    Forward: ``y = [L0 x; L1 x; ...]`` with replicated ``x`` — output
+    sharded over row-blocks. Adjoint: ``x = Σᵢ Lᵢᴴ yᵢ`` — replicated.
+    """
+
+    def __init__(self, ops: Sequence[LocalOperator],
+                 mask: Optional[Sequence[int]] = None,
+                 mesh=None, dtype=None):
+        self.ops = list(ops)
+        self.mask = tuple(mask) if mask is not None else None
+        from ..parallel.mesh import default_mesh
+        self.mesh = mesh if mesh is not None else default_mesh()
+        cols = {op.shape[1] for op in self.ops}
+        if len(cols) != 1:
+            raise ValueError("column size mismatch in MPIVStack")
+        self.nops = np.asarray([op.shape[0] for op in self.ops])
+        from .blockdiag import _chunk_ops
+        self.chunks = _chunk_ops(self.ops, int(self.mesh.devices.size))
+        self.local_shapes_n = tuple(
+            (int(sum(op.shape[0] for op in c)),) for c in self.chunks)
+        shape = (int(self.nops.sum()), int(cols.pop()))
+        dtype = dtype or np.result_type(*[op.dtype for op in self.ops])
+        super().__init__(shape=shape, dtype=dtype)
+
+    def _matvec(self, x: DistributedArray) -> DistributedArray:
+        # model is replicated (ref requires Partition.BROADCAST input,
+        # VStack.py:123-133)
+        xg = x.array
+        arr = jnp.concatenate([op.matvec(xg) for op in self.ops])
+        y = DistributedArray(global_shape=self.shape[0], mesh=self.mesh,
+                             partition=Partition.SCATTER, axis=0,
+                             local_shapes=self.local_shapes_n,
+                             mask=self.mask, dtype=arr.dtype)
+        y[:] = arr
+        return y
+
+    def _rmatvec(self, x: DistributedArray) -> DistributedArray:
+        offs = np.concatenate([[0], np.cumsum(self.nops)])
+        acc = None
+        for op, lo, hi in zip(self.ops, offs[:-1], offs[1:]):
+            part = op.rmatvec(x.array[int(lo):int(hi)])
+            acc = part if acc is None else acc + part
+        y = DistributedArray(global_shape=self.shape[1], mesh=self.mesh,
+                             partition=Partition.BROADCAST,
+                             mask=self.mask, dtype=acc.dtype)
+        y[:] = acc
+        return y
+
+
+class MPIStackedVStack(MPILinearOperator):
+    """Vertical stack of distributed operators: one shared model, stacked
+    data (ref ``VStack.py:153-203``). Output is a StackedDistributedArray
+    with one component per operator."""
+
+    def __init__(self, ops: Sequence[MPILinearOperator]):
+        self.ops = list(ops)
+        if len({op.shape[1] for op in self.ops}) != 1:
+            raise ValueError("column size mismatch in MPIStackedVStack")
+        shape = (int(sum(op.shape[0] for op in self.ops)), self.ops[0].shape[1])
+        dtype = np.result_type(*[op.dtype for op in self.ops])
+        super().__init__(shape=shape, dtype=dtype)
+
+    def _matvec(self, x: DistributedArray) -> StackedDistributedArray:
+        return StackedDistributedArray([op.matvec(x) for op in self.ops])
+
+    def _rmatvec(self, x: StackedDistributedArray) -> DistributedArray:
+        y = self.ops[0].rmatvec(x.distarrays[0])
+        for op, d in zip(self.ops[1:], x.distarrays[1:]):
+            y = y + op.rmatvec(d)
+        return y
+
+
+class MPIHStack(MPILinearOperator):
+    """Horizontal stack, implemented as the adjoint of a VStack of
+    adjoints — exactly the reference's trick (ref ``HStack.py:98-100``)."""
+
+    def __init__(self, ops: Sequence[LocalOperator],
+                 mask: Optional[Sequence[int]] = None,
+                 mesh=None, dtype=None):
+        self.vstack = MPIVStack([op.H for op in ops], mask=mask, mesh=mesh,
+                                dtype=dtype)
+        self.ops = self.vstack.ops
+        shape = (self.vstack.shape[1], self.vstack.shape[0])
+        super().__init__(shape=shape, dtype=self.vstack.dtype)
+
+    def _matvec(self, x: DistributedArray) -> DistributedArray:
+        return self.vstack._rmatvec(x)
+
+    def _rmatvec(self, x: DistributedArray) -> DistributedArray:
+        return self.vstack._matvec(x)

@@ -1,0 +1,3 @@
+from .mesh import make_mesh, make_mesh_2d, default_mesh, set_default_mesh
+from .partition import Partition, local_split
+from . import collectives

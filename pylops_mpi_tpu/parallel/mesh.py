@@ -1,0 +1,123 @@
+"""Device-mesh construction and management.
+
+TPU-native replacement for the reference's communicator plumbing
+(``pylops_mpi/utils/_mpi.py``, ``utils/_nccl.py``, and the
+``DistributedMixIn`` dispatch in ``pylops_mpi/Distributed.py:24-349``):
+instead of per-rank MPI/NCCL communicators, a single controller process
+drives a :class:`jax.sharding.Mesh` over the TPU slice, and all
+collectives are XLA ops (``psum``/``all_gather``/``all_to_all``/
+``ppermute``) emitted either implicitly by the partitioner or explicitly
+inside ``shard_map``.
+
+Sub-communicators (``MPI.Comm.Split`` / ``nccl_split``,
+ref ``pylops_mpi/DistributedArray.py:74-100``) map to named mesh axes or
+``axis_index_groups`` — see :mod:`pylops_mpi_tpu.parallel.collectives`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = [
+    "make_mesh",
+    "make_mesh_2d",
+    "default_mesh",
+    "set_default_mesh",
+    "local_device_count",
+    "best_grid_2d",
+]
+
+# The default axis name for 1-D sharding ("shard-parallel"); mirrors the
+# single flat COMM_WORLD of the reference.
+SP_AXIS = "sp"
+
+_DEFAULT_MESH: Optional[Mesh] = None
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def make_mesh(n_devices: Optional[int] = None, axis_name: str = SP_AXIS) -> Mesh:
+    """Build a 1-D device mesh over the first ``n_devices`` devices.
+
+    Equivalent role to ``MPI.COMM_WORLD`` in the reference: every
+    DistributedArray / operator is laid out over one of these.
+    """
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    if n_devices > len(devs):
+        raise ValueError(
+            f"requested {n_devices} devices but only {len(devs)} available")
+    return Mesh(np.asarray(devs[:n_devices]), (axis_name,))
+
+
+def best_grid_2d(n: int) -> Tuple[int, int]:
+    """Largest (pr, pc) grid with pr*pc == n and pr as close to sqrt(n).
+
+    TPU-native analog of the reference's ``active_grid_comm``
+    (``pylops_mpi/basicoperators/MatrixMult.py:24-79``), which drops ranks
+    to get a square grid: on a mesh we instead factor the device count so
+    no device idles.
+    """
+    pr = int(np.sqrt(n))
+    while n % pr != 0:
+        pr -= 1
+    return pr, n // pr
+
+
+def make_mesh_2d(
+    n_devices: Optional[int] = None,
+    axis_names: Tuple[str, str] = ("r", "c"),
+    grid: Optional[Tuple[int, int]] = None,
+) -> Mesh:
+    """Build a 2-D device mesh (process grid) for SUMMA-style matmuls.
+
+    Replaces the reference's row/column sub-communicators
+    (``pylops_mpi/basicoperators/MatrixMult.py:305-314,549-608``).
+    """
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    if grid is None:
+        grid = best_grid_2d(n_devices)
+    pr, pc = grid
+    if pr * pc != n_devices:
+        raise ValueError(f"grid {grid} does not tile {n_devices} devices")
+    return Mesh(np.asarray(devs[:n_devices]).reshape(pr, pc), axis_names)
+
+
+def default_mesh() -> Mesh:
+    """Process-wide default mesh (created lazily over all devices)."""
+    global _DEFAULT_MESH
+    if _DEFAULT_MESH is None:
+        _DEFAULT_MESH = make_mesh()
+    return _DEFAULT_MESH
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    global _DEFAULT_MESH
+    _DEFAULT_MESH = mesh
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def axis_sharding(mesh: Mesh, ndim: int, axis: int,
+                  axis_name: Optional[str] = None) -> NamedSharding:
+    """NamedSharding that shards dimension ``axis`` of an ``ndim`` array
+    over ``axis_name`` (default: the mesh's single axis)."""
+    if axis_name is None:
+        if len(mesh.axis_names) != 1:
+            raise ValueError("axis_name required for multi-axis mesh")
+        axis_name = mesh.axis_names[0]
+    spec = [None] * ndim
+    spec[axis] = axis_name
+    return NamedSharding(mesh, P(*spec))

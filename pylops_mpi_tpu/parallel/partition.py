@@ -1,0 +1,69 @@
+"""Partition model: how a global array is placed over the mesh.
+
+Mirrors the reference's three placement policies
+(``pylops_mpi/DistributedArray.py:26-71``):
+
+- ``Partition.BROADCAST``   — replicated on every device. In JAX a
+  replicated ``NamedSharding`` is consistent by construction, so the
+  reference's rank-0 re-broadcast on ``__setitem__``
+  (``DistributedArray.py:207-220``) has no analog: there is a single
+  logical value, updated once by the controller.
+- ``Partition.UNSAFE_BROADCAST`` — kept for API parity; identical to
+  ``BROADCAST`` here (the unsafe/safe distinction only exists when every
+  rank owns a private copy that can drift).
+- ``Partition.SCATTER``     — sharded along one axis with the balanced
+  remainder split of the reference (``local_split``,
+  ``DistributedArray.py:42-71``): the first ``dim % P`` shards get
+  ``ceil(dim/P)`` rows, the rest ``floor(dim/P)``.
+
+XLA requires equal per-device shards, so ragged splits are realised as
+pad-to-max + static masks (the approach the reference's NCCL path already
+uses, ``utils/_nccl.py:363-403``); logical sizes live in metadata.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Partition", "local_split", "shard_offsets", "padded_shard_size"]
+
+
+class Partition(Enum):
+    ALL = "All"            # alias kept out of public docs
+    BROADCAST = "Broadcast"
+    UNSAFE_BROADCAST = "UnsafeBroadcast"
+    SCATTER = "Scatter"
+
+
+def local_split(global_shape: Tuple[int, ...], n_shards: int,
+                partition: Partition, axis: int) -> Tuple[Tuple[int, ...], ...]:
+    """Per-shard logical shapes (ref ``DistributedArray.py:42-71``).
+
+    For ``SCATTER``, dimension ``axis`` is split into ``n_shards`` pieces
+    with the balanced remainder rule; all other dims are unchanged. For
+    broadcast partitions every shard sees the full global shape.
+    """
+    if partition in (Partition.BROADCAST, Partition.UNSAFE_BROADCAST):
+        return tuple(tuple(global_shape) for _ in range(n_shards))
+    dim = global_shape[axis]
+    base, rem = divmod(dim, n_shards)
+    sizes = [base + 1 if i < rem else base for i in range(n_shards)]
+    shapes = []
+    for s in sizes:
+        shp = list(global_shape)
+        shp[axis] = s
+        shapes.append(tuple(shp))
+    return tuple(shapes)
+
+
+def shard_offsets(local_sizes: Sequence[int]) -> Tuple[int, ...]:
+    """Exclusive prefix sum of per-shard sizes along the partition axis."""
+    return tuple(int(x) for x in np.concatenate([[0], np.cumsum(local_sizes)[:-1]]))
+
+
+def padded_shard_size(local_sizes: Sequence[int]) -> int:
+    """Physical (equal) per-shard size: pad-to-max."""
+    return int(max(local_sizes)) if len(local_sizes) else 0

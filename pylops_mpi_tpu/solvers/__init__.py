@@ -1,0 +1,1 @@
+from .basic import CG, CGLS, cg, cgls

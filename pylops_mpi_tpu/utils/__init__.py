@@ -1,0 +1,1 @@
+from .dottest import dottest

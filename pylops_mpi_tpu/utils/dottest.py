@@ -1,0 +1,38 @@
+"""Distributed adjoint (dot) test — rebuild of
+``pylops_mpi/utils/dottest.py:11-107``: checks
+``(Op u)ᴴ v == uᴴ (Opᴴ v)`` on gathered global arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["dottest"]
+
+
+def dottest(Op, u, v, nr: Optional[int] = None, nc: Optional[int] = None,
+            rtol: float = 1e-6, atol: float = 1e-21,
+            raiseerror: bool = True, verb: bool = False) -> bool:
+    if nr is None:
+        nr = Op.shape[0]
+    if nc is None:
+        nc = Op.shape[1]
+    if (nr, nc) != Op.shape:
+        raise AssertionError("Provided nr and nc do not match operator shape")
+
+    y = Op.matvec(u)
+    x = Op.rmatvec(v)
+
+    yy = np.vdot(y.asarray(), v.asarray())
+    xx = np.vdot(u.asarray(), x.asarray())
+
+    passed = bool(np.isclose(xx, yy, rtol, atol))
+    if (not passed and raiseerror) or verb:
+        status = "passed" if passed else "failed"
+        msg = f"Dot test {status}, v^H(Opu)={yy} - u^H(Op^Hv)={xx}"
+        if not passed and raiseerror:
+            raise AssertionError(msg)
+        print(msg)
+    return passed
