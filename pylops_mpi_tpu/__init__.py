@@ -17,12 +17,28 @@ from .linearoperator import (
 )
 from .ops.blockdiag import MPIBlockDiag, MPIStackedBlockDiag
 from .ops.stack import MPIVStack, MPIStackedVStack, MPIHStack
+from .ops.derivatives import (MPIFirstDerivative, MPISecondDerivative,
+                              MPILaplacian, MPIGradient)
+from .ops.matrixmult import MPIMatrixMult
+from .ops.halo import MPIHalo, halo_block_split
+from .ops.nonstatconv import MPINonStationaryConvolve1D
+from .ops.fft import MPIFFTND, MPIFFT2D
+from .ops.fredholm import MPIFredholm1
+from .ops.mdc import MPIMDC
 from .solvers.basic import CG, CGLS, cg, cgls
+from .solvers.sparsity import ISTA, FISTA, ista, fista
+from .solvers.eigs import power_iteration
 from .utils.dottest import dottest
 
 from . import ops
 from . import solvers
 from . import utils
 from . import parallel
+from . import basicoperators
+from . import signalprocessing
+from . import waveeqprocessing
+from . import optimization
+from . import plotting
+from . import models
 
 __version__ = "0.1.0"

@@ -1,0 +1,7 @@
+"""Namespace parity with ``pylops_mpi.basicoperators``."""
+from ..ops.blockdiag import MPIBlockDiag, MPIStackedBlockDiag
+from ..ops.stack import MPIVStack, MPIStackedVStack, MPIHStack
+from ..ops.derivatives import (MPIFirstDerivative, MPISecondDerivative,
+                               MPILaplacian, MPIGradient)
+from ..ops.matrixmult import MPIMatrixMult
+from ..ops.halo import MPIHalo, halo_block_split

@@ -380,7 +380,11 @@ class DistributedArray:
         if isinstance(x, DistributedArray):
             self._check_compat(x)
             if x._axis_sizes != self._axis_sizes:
-                raise ValueError("local shape mismatch")
+                # different logical splits of the same global shape:
+                # repack through the logical view (the reference instead
+                # raises — rebalancing is the @reshaped decorator's job
+                # there, ref utils/decorators.py:9-86)
+                return self._from_global(x._global())
             return x._arr
         if isinstance(x, (jax.Array, np.ndarray)) and np.ndim(x) == 1 \
                 and self._mask is not None \

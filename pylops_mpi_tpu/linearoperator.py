@@ -78,20 +78,23 @@ class MPILinearOperator:
                 f"dimension mismatch: operator {self.shape}, x {x.global_shape}")
         return self._rmatvec(x)
 
+    def _wrap_local(self, y, x: "DistributedArray", n: int):
+        out = DistributedArray(global_shape=n, mesh=x.mesh,
+                               partition=x.partition, axis=0,
+                               mask=x.mask, dtype=y.dtype)
+        out[:] = y
+        return out
+
     def _matvec(self, x: VectorLike) -> VectorLike:
         if self.Op is not None:
-            y = self.Op.matvec(x.array.ravel())
-            return DistributedArray.to_dist(
-                y, mesh=x.mesh, partition=x.partition,
-                axis=0) if not isinstance(y, DistributedArray) else y
+            return self._wrap_local(self.Op.matvec(x.array.ravel()), x,
+                                    self.shape[0])
         raise NotImplementedError
 
     def _rmatvec(self, x: VectorLike) -> VectorLike:
         if self.Op is not None:
-            y = self.Op.rmatvec(x.array.ravel())
-            return DistributedArray.to_dist(
-                y, mesh=x.mesh, partition=x.partition,
-                axis=0) if not isinstance(y, DistributedArray) else y
+            return self._wrap_local(self.Op.rmatvec(x.array.ravel()), x,
+                                    self.shape[1])
         raise NotImplementedError
 
     # ----------------------------------------------------------- algebra

@@ -1,0 +1,4 @@
+"""Application pipelines (L6) — analogs of the reference's tutorials."""
+from .poststack import (PoststackLinearModelling, MPIPoststackLinearModelling,
+                        poststack_inversion, ricker)
+from .mdd import mdd, kernel_to_frequency

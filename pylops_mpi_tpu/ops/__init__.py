@@ -1,3 +1,11 @@
 from . import local
 from .blockdiag import MPIBlockDiag, MPIStackedBlockDiag
 from .stack import MPIVStack, MPIStackedVStack, MPIHStack
+from .derivatives import (MPIFirstDerivative, MPISecondDerivative,
+                          MPILaplacian, MPIGradient)
+from .matrixmult import MPIMatrixMult, local_block_split, block_gather
+from .halo import MPIHalo, halo_block_split
+from .nonstatconv import MPINonStationaryConvolve1D
+from .fft import MPIFFTND, MPIFFT2D
+from .fredholm import MPIFredholm1
+from .mdc import MPIMDC

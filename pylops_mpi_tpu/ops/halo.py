@@ -1,0 +1,222 @@
+"""N-D Cartesian halo operator.
+
+Rebuild of ``pylops_mpi/basicoperators/Halo.py:12-423``. The reference
+arranges ranks in an MPI Cartesian grid (``Create_cart`` + ``Shift``
+neighbours, ref ``229-241``), zero-pads each local block and fills the
+halo zones with per-axis ``Sendrecv`` exchanges (ref ``320-360``) —
+corners arrive via the sequential-axis relay. The adjoint crops the halo
+(ref ``400-423``). Collective halo-width validation (BOR-allreduce of
+error bits, ref ``280-318``) becomes plain host-side checks: the
+controller sees every block's metadata.
+
+One-controller equivalence: a block's haloed extent is exactly the
+zero-padded global-array window ``[start-h⁻, end+h⁺)`` (the sequential
+exchange relay reconstructs precisely this, diagonal corners included),
+so forward/adjoint are static window slices of the logical global array
+whose neighbour transfers XLA schedules over ICI.
+
+Designed, as in the reference, to sandwich local operators:
+``HOp.H @ MPIBlockDiag(local ops) @ HOp``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..distributedarray import DistributedArray, Partition
+from ..linearoperator import MPILinearOperator
+
+__all__ = ["MPIHalo", "halo_block_split"]
+
+
+def _cart_coords(rank: int, grid: Tuple[int, ...]) -> Tuple[int, ...]:
+    return tuple(int(c) for c in np.unravel_index(rank, grid))
+
+
+def halo_block_split(global_shape: Tuple[int, ...], rank: int,
+                     grid_shape: Optional[Tuple[int, ...]] = None,
+                     n_shards: Optional[int] = None) -> Tuple[slice, ...]:
+    """Local slice owned by ``rank`` under the Cartesian ceil-block split
+    (ref ``halo_block_split``, ``Halo.py:12-66``; takes the rank index
+    instead of a communicator)."""
+    ndim = len(global_shape)
+    if grid_shape is None:
+        if n_shards is None:
+            raise ValueError("grid_shape or n_shards required")
+        grid_shape = (1,) * (ndim - 1) + (n_shards,)
+    if int(np.prod(grid_shape)) <= rank or rank < 0:
+        raise ValueError(f"rank {rank} outside grid {grid_shape}")
+    coords = _cart_coords(rank, grid_shape)
+    slices = []
+    for gdim, procs, coord in zip(global_shape, grid_shape, coords):
+        bs = math.ceil(gdim / procs)
+        start = coord * bs
+        end = min(start + bs, gdim)
+        slices.append(slice(start, end))
+    return tuple(slices)
+
+
+class MPIHalo(MPILinearOperator):
+    """Halo (ghost-zone) operator over a Cartesian block decomposition
+    (ref ``Halo.py:69-423``).
+
+    ``halo`` may be a scalar (symmetric everywhere, trimmed to zero on
+    grid boundaries as the reference does for scalars, ref ``197-215``),
+    a length-``ndim`` tuple (symmetric per axis, kept at boundaries with
+    zero fill), or a length-``2*ndim`` tuple of (minus, plus) pairs.
+    """
+
+    def __init__(self, dims, halo, proc_grid_shape=None, mesh=None,
+                 dtype=np.float64):
+        self.global_dims = tuple(int(d) for d in np.atleast_1d(dims))
+        self.ndim = len(self.global_dims)
+        from ..parallel.mesh import default_mesh
+        self.mesh = mesh if mesh is not None else default_mesh()
+        P_ = int(self.mesh.devices.size)
+        if proc_grid_shape is None:
+            proc_grid_shape = (1,) * (self.ndim - 1) + (P_,)
+        self.proc_grid_shape = tuple(int(g) for g in proc_grid_shape)
+        if int(np.prod(self.proc_grid_shape)) != P_:
+            raise ValueError(
+                f"grid_shape {self.proc_grid_shape} does not match mesh size {P_}")
+        scalar_halo = isinstance(halo, (int, np.integer))
+        base = self._parse_halo(halo)
+        # per-rank geometry
+        self.block_slices: List[Tuple[slice, ...]] = []
+        self.halos: List[Tuple[int, ...]] = []
+        self.local_dims_all: List[Tuple[int, ...]] = []
+        self.extents: List[Tuple[int, ...]] = []
+        for r in range(P_):
+            coords = _cart_coords(r, self.proc_grid_shape)
+            sl = halo_block_split(self.global_dims, r, self.proc_grid_shape)
+            h = list(base)
+            if scalar_halo:
+                # ref trims scalar halos at grid boundaries (Halo.py:204-210)
+                for ax in range(self.ndim):
+                    if coords[ax] == 0:
+                        h[2 * ax] = 0
+                    if coords[ax] == self.proc_grid_shape[ax] - 1:
+                        h[2 * ax + 1] = 0
+            ld = tuple(s.stop - s.start for s in sl)
+            ext = tuple(ld[ax] + h[2 * ax] + h[2 * ax + 1]
+                        for ax in range(self.ndim))
+            self.block_slices.append(sl)
+            self.halos.append(tuple(h))
+            self.local_dims_all.append(ld)
+            self.extents.append(ext)
+        self._validate_widths()
+        self.local_dim_sizes = tuple((int(np.prod(ld)),)
+                                     for ld in self.local_dims_all)
+        self.local_extent_sizes = tuple((int(np.prod(e)),)
+                                        for e in self.extents)
+        n = int(np.prod(self.global_dims))
+        m = int(sum(np.prod(e) for e in self.extents))
+        self.dims = self.global_dims
+        self.dimsd = (m,)
+        super().__init__(shape=(m, n), dtype=np.dtype(dtype))
+
+    def _parse_halo(self, h) -> Tuple[int, ...]:
+        """ref ``Halo.py:197-227``"""
+        if isinstance(h, (int, np.integer)):
+            halo = (int(h),) * (2 * self.ndim)
+        else:
+            h = tuple(int(v) for v in h)
+            if len(h) == 1:
+                halo = h * (2 * self.ndim)
+            elif len(h) == self.ndim:
+                halo = sum(((d, d) for d in h), ())
+            elif len(h) == 2 * self.ndim:
+                halo = h
+            else:
+                raise ValueError(
+                    f"Invalid halo length {len(h)} for ndim={self.ndim}")
+        if any(v < 0 for v in halo):
+            raise ValueError("Halo widths must be non-negative")
+        return halo
+
+    def _validate_widths(self) -> None:
+        """One-hop exchange feasibility (ref ``Halo.py:280-318``): a halo
+        may not be wider than the neighbouring block it is read from."""
+        for r, (h, ld) in enumerate(zip(self.halos, self.local_dims_all)):
+            coords = _cart_coords(r, self.proc_grid_shape)
+            for ax in range(self.ndim):
+                has_minus = coords[ax] > 0
+                has_plus = coords[ax] < self.proc_grid_shape[ax] - 1
+                if (h[2 * ax] > ld[ax] and has_minus) or \
+                        (h[2 * ax + 1] > ld[ax] and has_plus):
+                    raise ValueError(
+                        "MPIHalo halo widths are not supported by the "
+                        "current one-hop exchange: halo width exceeds "
+                        "local block size")
+
+    # ------------------------------------------------------------- apply
+    def _global_from_blocks(self, x: DistributedArray,
+                            sizes) -> jnp.ndarray:
+        """Reassemble the logical N-D global array from the rank-major
+        concatenation of raveled local blocks."""
+        g = jnp.zeros(self.global_dims, dtype=x.dtype)
+        flat = x.array
+        off = 0
+        for sl, ld in zip(self.block_slices, self.local_dims_all):
+            n = int(np.prod(ld))
+            g = g.at[sl].set(flat[off:off + n].reshape(ld))
+            off += n
+        return g
+
+    def _matvec(self, x: DistributedArray) -> DistributedArray:
+        if x.partition != Partition.SCATTER:
+            raise ValueError(
+                f"x should have partition={Partition.SCATTER} "
+                f"Got {x.partition} instead...")
+        if tuple(x._axis_sizes) != tuple(s[0] for s in self.local_dim_sizes):
+            raise ValueError(
+                "MPIHalo input local shapes do not match the Cartesian "
+                "block decomposition")
+        g = self._global_from_blocks(x, self.local_dim_sizes)
+        parts = []
+        for sl, h in zip(self.block_slices, self.halos):
+            padw, idx = [], []
+            for ax in range(self.ndim):
+                lo = sl[ax].start - h[2 * ax]
+                hi = sl[ax].stop + h[2 * ax + 1]
+                lo_c, hi_c = max(lo, 0), min(hi, self.global_dims[ax])
+                padw.append((lo_c - lo, hi - hi_c))
+                idx.append(slice(lo_c, hi_c))
+            blk = jnp.pad(g[tuple(idx)], padw)
+            parts.append(blk.ravel())
+        arr = jnp.concatenate(parts)
+        y = DistributedArray(global_shape=self.shape[0], mesh=x.mesh,
+                             partition=Partition.SCATTER, axis=0,
+                             local_shapes=self.local_extent_sizes,
+                             dtype=x.dtype)
+        y[:] = arr
+        return y
+
+    def _rmatvec(self, x: DistributedArray) -> DistributedArray:
+        """Crop halo zones (ref ``Halo.py:400-423``). Like the reference,
+        this is the sandwich-inverse, not the strict adjoint: ghost
+        contributions are discarded, not scatter-added."""
+        if x.partition != Partition.SCATTER:
+            raise ValueError(
+                f"x should have partition={Partition.SCATTER} "
+                f"Got {x.partition} instead...")
+        flat = x.array
+        parts, off = [], 0
+        for h, ld, ext in zip(self.halos, self.local_dims_all, self.extents):
+            n = int(np.prod(ext))
+            blk = flat[off:off + n].reshape(ext)
+            core = tuple(slice(h[2 * ax], h[2 * ax] + ld[ax])
+                         for ax in range(self.ndim))
+            parts.append(blk[core].ravel())
+            off += n
+        arr = jnp.concatenate(parts)
+        y = DistributedArray(global_shape=self.shape[1], mesh=x.mesh,
+                             partition=Partition.SCATTER, axis=0,
+                             local_shapes=self.local_dim_sizes,
+                             dtype=x.dtype)
+        y[:] = arr
+        return y

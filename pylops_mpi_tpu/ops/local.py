@@ -21,7 +21,7 @@ __all__ = [
     "LocalOperator", "MatrixMult", "Identity", "Diagonal", "Zero",
     "Transpose", "FirstDerivative", "SecondDerivative", "Laplacian",
     "Roll", "Pad", "Flip", "FunctionOperator", "VStack", "HStack",
-    "BlockDiag", "FFT", "Conv1D",
+    "BlockDiag", "FFT", "Conv1D", "NonStationaryConvolve1D",
 ]
 
 
@@ -329,12 +329,21 @@ class FirstDerivative(LocalOperator):
     """Local first derivative, matching pylops' stencils so the
     distributed variant (ref ``basicoperators/FirstDerivative.py``) has a
     bit-exact local building block. ``kind``: forward | backward |
-    centered (3-point, zero at both edges, as pylops ``edge=False``)."""
+    centered (3- or 5-point; zero rows at the boundary unless ``edge``).
+
+    Implementation note: written entirely with pad/concat arithmetic —
+    no ``.at[]`` scatters — because XLA's SPMD partitioner miscompiles
+    scatter/dynamic-update-slice ops on sharded operands (observed on
+    the CPU backend of jax 0.9; GSPMD is shared with TPU).
+    """
 
     def __init__(self, dims, axis: int = 0, sampling: float = 1.0,
-                 kind: str = "centered", edge: bool = False, dtype=None):
+                 kind: str = "centered", edge: bool = False, order: int = 3,
+                 dtype=None):
         self.dims_nd, self.axis, self.sampling = _deriv_setup(dims, axis, sampling)
-        self.kind, self.edge = kind, edge
+        self.kind, self.edge, self.order = kind, edge, order
+        if kind == "centered" and order not in (3, 5):
+            raise NotImplementedError("'order' must be 3 or 5")
         super().__init__(self.dims_nd, self.dims_nd, dtype=dtype)
 
     def _move(self, x):
@@ -343,64 +352,90 @@ class FirstDerivative(LocalOperator):
     def _back(self, y):
         return jnp.moveaxis(y, 0, self.axis).ravel()
 
+    @staticmethod
+    def _pad0(v, before, after):
+        padw = [(before, after)] + [(0, 0)] * (v.ndim - 1)
+        return jnp.pad(v, padw)
+
     def _matvec(self, x):
         v = self._move(x)
         s = self.sampling
+        p = self._pad0
         if self.kind == "forward":
-            y = jnp.zeros_like(v).at[:-1].set((v[1:] - v[:-1]) / s)
+            y = p((v[1:] - v[:-1]) / s, 0, 1)
         elif self.kind == "backward":
-            y = jnp.zeros_like(v).at[1:].set((v[1:] - v[:-1]) / s)
-        else:
-            y = jnp.zeros_like(v).at[1:-1].set((v[2:] - v[:-2]) / (2 * s))
+            y = p((v[1:] - v[:-1]) / s, 1, 0)
+        elif self.order == 3:
+            y = p((v[2:] - v[:-2]) / (2 * s), 1, 1)
             if self.edge:
-                y = y.at[0].set((v[1] - v[0]) / s)
-                y = y.at[-1].set((v[-1] - v[-2]) / s)
+                y = y + p(((v[1] - v[0]) / s)[None], 0, v.shape[0] - 1)
+                y = y + p(((v[-1] - v[-2]) / s)[None], v.shape[0] - 1, 0)
+        else:  # centered, 5-point: (x[i-2] - 8x[i-1] + 8x[i+1] - x[i+2])/12Δ
+            y = p((v[:-4] - 8 * v[1:-3] + 8 * v[3:-1] - v[4:]) / (12 * s), 2, 2)
+            if self.edge:
+                n = v.shape[0]
+                y = y + p(((v[1] - v[0]) / s)[None], 0, n - 1)
+                y = y + p(((v[2] - v[0]) / (2 * s))[None], 1, n - 2)
+                y = y + p(((v[-1] - v[-3]) / (2 * s))[None], n - 2, 1)
+                y = y + p(((v[-1] - v[-2]) / s)[None], n - 1, 0)
         return self._back(y)
 
     def _rmatvec(self, x):
         v = self._move(x)
         s = self.sampling
+        n = v.shape[0]
+        p = self._pad0
         if self.kind == "forward":
-            y = jnp.zeros_like(v)
-            y = y.at[:-1].add(-v[:-1] / s)
-            y = y.at[1:].add(v[:-1] / s)
+            c = v[:-1] / s
+            y = p(c, 1, 0) - p(c, 0, 1)
         elif self.kind == "backward":
-            y = jnp.zeros_like(v)
-            y = y.at[:-1].add(-v[1:] / s)
-            y = y.at[1:].add(v[1:] / s)
-        else:
-            y = jnp.zeros_like(v)
-            y = y.at[:-2].add(-v[1:-1] / (2 * s))
-            y = y.at[2:].add(v[1:-1] / (2 * s))
+            c = v[1:] / s
+            y = p(c, 1, 0) - p(c, 0, 1)
+        elif self.order == 3:
+            c = v[1:-1] / (2 * s)
+            y = p(c, 2, 0) - p(c, 0, 2)
             if self.edge:
-                y = y.at[0].add(-v[0] / s)
-                y = y.at[1].add(v[0] / s)
-                y = y.at[-2].add(-v[-1] / s)
-                y = y.at[-1].add(v[-1] / s)
+                e0 = jnp.stack([-v[0] / s, v[0] / s])
+                y = y + p(e0, 0, n - 2)
+                e1 = jnp.stack([-v[-1] / s, v[-1] / s])
+                y = y + p(e1, n - 2, 0)
+        else:
+            c = v[2:-2] / (12 * s)
+            y = p(c, 0, 4) - 8 * p(c, 1, 3) + 8 * p(c, 3, 1) - p(c, 4, 0)
+            if self.edge:
+                y = y + p(jnp.stack([-v[0] / s, v[0] / s]), 0, n - 2)
+                y = y + p(jnp.stack([-v[1] / (2 * s), jnp.zeros_like(v[1]),
+                                     v[1] / (2 * s)]), 0, n - 3)
+                y = y + p(jnp.stack([-v[-2] / (2 * s), jnp.zeros_like(v[1]),
+                                     v[-2] / (2 * s)]), n - 3, 0)
+                y = y + p(jnp.stack([-v[-1] / s, v[-1] / s]), n - 2, 0)
         return self._back(y)
 
 
 class SecondDerivative(LocalOperator):
-    """3-point second derivative (pylops ``edge=False`` semantics)."""
+    """3-point second derivative (pylops ``edge=False`` semantics);
+    scatter-free for partitioner safety (see FirstDerivative note)."""
 
     def __init__(self, dims, axis: int = 0, sampling: float = 1.0,
                  dtype=None):
         self.dims_nd, self.axis, self.sampling = _deriv_setup(dims, axis, sampling)
         super().__init__(self.dims_nd, self.dims_nd, dtype=dtype)
 
+    @staticmethod
+    def _pad0(v, before, after):
+        padw = [(before, after)] + [(0, 0)] * (v.ndim - 1)
+        return jnp.pad(v, padw)
+
     def _matvec(self, x):
         v = jnp.moveaxis(x.reshape(self.dims_nd), self.axis, 0)
         s2 = self.sampling ** 2
-        y = jnp.zeros_like(v).at[1:-1].set((v[2:] - 2 * v[1:-1] + v[:-2]) / s2)
+        y = self._pad0((v[2:] - 2 * v[1:-1] + v[:-2]) / s2, 1, 1)
         return jnp.moveaxis(y, 0, self.axis).ravel()
 
     def _rmatvec(self, x):
         v = jnp.moveaxis(x.reshape(self.dims_nd), self.axis, 0)
-        s2 = self.sampling ** 2
-        y = jnp.zeros_like(v)
-        y = y.at[:-2].add(v[1:-1] / s2)
-        y = y.at[1:-1].add(-2 * v[1:-1] / s2)
-        y = y.at[2:].add(v[1:-1] / s2)
+        c = v[1:-1] / self.sampling ** 2
+        y = self._pad0(c, 0, 2) - 2 * self._pad0(c, 1, 1) + self._pad0(c, 2, 0)
         return jnp.moveaxis(y, 0, self.axis).ravel()
 
 
@@ -499,12 +534,14 @@ class FFT(LocalOperator):
     distributed FFT preserves (ref ``signalprocessing/FFTND.py:278-309``)."""
 
     def __init__(self, dims, axis: int = 0, nfft: Optional[int] = None,
-                 real: bool = True, dtype=None):
+                 real: bool = True, ifftshift_before: bool = False,
+                 dtype=None):
         dims = tuple(np.atleast_1d(dims))
         self.dims_nd = dims
         self.axis = axis % len(dims)
         self.nfft = nfft or dims[self.axis]
         self.real = real
+        self.ifftshift_before = bool(ifftshift_before)
         nf = self.nfft // 2 + 1 if real else self.nfft
         dimsd = list(dims)
         dimsd[self.axis] = nf
@@ -515,12 +552,19 @@ class FFT(LocalOperator):
         super().__init__(dims, self.dimsd_nd, dtype=cplx)
 
     def _scale_pos(self, y, factor):
-        idx = [slice(None)] * len(self.dimsd_nd)
-        idx[self.axis] = slice(1, self._double_hi)
-        return y.at[tuple(idx)].multiply(factor)
+        # mask-multiply, not .at[].multiply: scatter ops miscompile under
+        # the SPMD partitioner on sharded operands
+        nf = self.dimsd_nd[self.axis]
+        ar = jnp.arange(nf)
+        fac = jnp.where((ar >= 1) & (ar < self._double_hi), factor, 1.0)
+        shape = [1] * len(self.dimsd_nd)
+        shape[self.axis] = nf
+        return y * fac.reshape(shape)
 
     def _matvec(self, x):
         v = x.reshape(self.dims_nd)
+        if self.ifftshift_before:
+            v = jnp.fft.ifftshift(v, axes=self.axis)
         if self.real:
             y = jnp.fft.rfft(v.real, n=self.nfft, axis=self.axis, norm="ortho")
             y = self._scale_pos(y, np.sqrt(2.0))
@@ -539,7 +583,10 @@ class FFT(LocalOperator):
             y = jnp.fft.ifft(v, n=self.nfft, axis=self.axis, norm="ortho")
         idx = [slice(None)] * len(self.dims_nd)
         idx[self.axis] = slice(0, self.dims_nd[self.axis])
-        return y[tuple(idx)].ravel()
+        y = y[tuple(idx)]
+        if self.ifftshift_before:
+            y = jnp.fft.fftshift(y, axes=self.axis)
+        return y.ravel()
 
 
 class Conv1D(LocalOperator):
@@ -576,3 +623,69 @@ class Conv1D(LocalOperator):
         # correlation = convolution with reversed conj filter, mirrored offset
         h = jnp.flip(jnp.conj(self.h))
         return self._conv(x, h, self.h.shape[0] - 1 - self.offset)
+
+
+class NonStationaryConvolve1D(LocalOperator):
+    """1-D non-stationary convolution with a bank of compact filters
+    defined on a coarse grid and linearly interpolated per sample
+    (jnp-native analog of ``pylops.signalprocessing.NonStationaryConvolve1D``,
+    the rank-local building block of the reference's distributed factory,
+    ref ``pylops_mpi/signalprocessing/NonStatConvolve1d.py:139-188``).
+
+    Forward spreads each input sample through its interpolated filter:
+    ``y[i-nh//2+j] += hs_i[j] * x[i]``; adjoint gathers.
+    """
+
+    def __init__(self, dims, hs, ih, axis: int = -1, dtype=None):
+        dims = tuple(np.atleast_1d(dims))
+        self.dims_nd = dims
+        self.axis = axis % len(dims)
+        hs = jnp.asarray(hs)
+        ih = np.asarray(ih)
+        if hs.shape[1] % 2 == 0:
+            raise ValueError("filters hs must have odd length")
+        if len(np.unique(np.diff(ih))) > 1:
+            raise ValueError(
+                "the indices of filters 'ih' are must be regularly sampled")
+        self.hs, self.ih = hs, ih
+        self.nh = int(hs.shape[1])
+        n = dims[self.axis]
+        # static per-sample interpolated filter bank (n, nh): nearest
+        # filter outside [ih[0], ih[-1]], linear blend inside
+        pos = np.arange(n, dtype=float)
+        dh = float(ih[1] - ih[0]) if len(ih) > 1 else 1.0
+        q = (pos - ih[0]) / dh
+        i0 = np.clip(np.floor(q).astype(int), 0, len(ih) - 2 if len(ih) > 1 else 0)
+        w = np.clip(q - i0, 0.0, 1.0)[:, None]
+        if len(ih) > 1:
+            self.Hbank = hs[i0] * (1 - w) + hs[i0 + 1] * w
+        else:
+            self.Hbank = jnp.broadcast_to(hs[0], (n, self.nh))
+        super().__init__(dims, dims, dtype=dtype or hs.dtype)
+
+    def _batched(self, x):
+        v = jnp.moveaxis(x.reshape(self.dims_nd), self.axis, -1)
+        return v.reshape(-1, self.dims_nd[self.axis]), v.shape
+
+    def _unbatch(self, y2, shp):
+        return jnp.moveaxis(y2.reshape(shp), -1, self.axis).ravel()
+
+    def _matvec(self, x):
+        v2, shp = self._batched(x)
+        n = v2.shape[1]
+        half = self.nh // 2
+        # pad-and-sum formulation (scatter-free, see FirstDerivative note)
+        ypad = sum(
+            jnp.pad(v2 * self.Hbank[:, j], ((0, 0), (j, self.nh - 1 - j)))
+            for j in range(self.nh))
+        return self._unbatch(ypad[:, half:half + n], shp)
+
+    def _rmatvec(self, x):
+        v2, shp = self._batched(x)
+        n = v2.shape[1]
+        half = self.nh // 2
+        vpad = jnp.pad(v2, ((0, 0), (half, half)))
+        out = jnp.zeros_like(v2)
+        for j in range(self.nh):
+            out = out + jnp.conj(self.Hbank[:, j]) * vpad[:, j:j + n]
+        return self._unbatch(out, shp)

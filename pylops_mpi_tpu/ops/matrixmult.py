@@ -1,0 +1,239 @@
+"""Distributed dense matrix multiplication (tensor parallelism).
+
+Rebuild of ``pylops_mpi/basicoperators/MatrixMult.py`` — the reference's
+two schemes:
+
+- **block** (ref ``178-427``): A row-blocked, X/Y column-blocked over a
+  √P×√P grid; forward does a row-communicator allgather, adjoint a
+  row-communicator allreduce.
+- **SUMMA** (ref ``430-765``): 2-D tiles, √P iterations of row/col
+  broadcasts + local GEMM accumulate; the adjoint pipelines Aᴴ tiles
+  with tagged p2p sends.
+
+TPU-native: both become one ``einsum`` on the MXU under sharding
+constraints. ``kind="block"`` shards A by rows on the 1-D mesh
+(forward: zero comm; adjoint: one ``psum``). ``kind="summa"`` tiles A,
+X and Y over a 2-D mesh and runs an explicit ``shard_map`` kernel —
+all-gather A-tiles along grid columns, all-gather X-tiles along grid
+rows, then a single local GEMM: the √P-step broadcast pipeline of the
+reference collapses into one collective + one MXU-saturating GEMM
+(the tagged-p2p adjoint pipeline, ref ``744-761``, becomes the mirrored
+all-gather — SURVEY §7 hard-part resolved). ``kind="auto"`` lays the
+same tiling down as sharding constraints and lets XLA's SPMD partitioner
+derive the schedule.
+
+Deliberate departure: the reference's flat model vector physically
+replicates X across grid rows (its global length is ``K * Σ_ranks
+M_loc ≈ K·M·√P``, ref ``306-316``); here model and data are the unique
+``(K·M,)`` / ``(N·M,)`` vectors — same operator, no duplicated storage.
+
+Grid helpers mirror ref ``MatrixMult.py:24-175``: ``best_grid_2d``
+replaces ``active_grid_comm`` (we factor P instead of idling ranks),
+``local_block_split`` gives tile ownership slices, ``block_gather``
+reassembles a tiled matrix.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..distributedarray import DistributedArray, Partition, local_split
+from ..linearoperator import MPILinearOperator
+from ..parallel.mesh import default_mesh, make_mesh_2d, best_grid_2d
+
+__all__ = ["MPIMatrixMult", "local_block_split", "block_gather"]
+
+
+def local_block_split(global_shape: Tuple[int, int], rank: int,
+                      grid: Tuple[int, int]) -> Tuple[slice, slice]:
+    """Tile ownership of a 2-D block layout
+    (ref ``MatrixMult.py:82-129``): grid position (i, j) of ``rank`` owns
+    ``ceil``-sized block (i, j)."""
+    pr, pc = grid
+    i, j = divmod(rank, pc)
+    if not (0 <= i < pr and 0 <= j < pc):
+        raise ValueError(f"rank {rank} outside grid {grid}")
+    br = int(np.ceil(global_shape[0] / pr))
+    bc = int(np.ceil(global_shape[1] / pc))
+    return (slice(i * br, min((i + 1) * br, global_shape[0])),
+            slice(j * bc, min((j + 1) * bc, global_shape[1])))
+
+
+def block_gather(blocks, global_shape: Tuple[int, int],
+                 grid: Tuple[int, int]) -> np.ndarray:
+    """Reassemble a list of per-rank tiles (row-major rank order) into the
+    dense matrix (ref ``block_gather``, ``MatrixMult.py:132-175``)."""
+    out = np.zeros(global_shape, dtype=np.asarray(blocks[0]).dtype)
+    for rank, blk in enumerate(blocks):
+        rs, cs = local_block_split(global_shape, rank, grid)
+        out[rs, cs] = np.asarray(blk)
+    return out
+
+
+def _pad_to(x: jax.Array, rows: int, cols: int) -> jax.Array:
+    return jnp.pad(x, ((0, rows - x.shape[0]), (0, cols - x.shape[1])))
+
+
+class _MatMulBase(MPILinearOperator):
+    def __init__(self, A, M: int, mesh=None, dtype=None, saveAt: bool = False):
+        A = jnp.asarray(A, dtype=dtype)
+        self.N, self.K = A.shape
+        self.M = int(M)
+        self.mesh = mesh if mesh is not None else default_mesh()
+        self.saveAt = saveAt
+        self.dims = (self.K, self.M)
+        self.dimsd = (self.N, self.M)
+        super().__init__(shape=(self.N * self.M, self.K * self.M),
+                         dtype=dtype or A.dtype)
+        self.A = self._place_A(A)
+        # adjoint reuses conj(A) tiles on the fly unless saveAt
+        # (ref MatrixMult.py:288-292)
+        self.At = jnp.conj(A).T if saveAt else None
+
+    def _place_A(self, A):
+        return A
+
+    def _wrap_out(self, arr: jax.Array, x: DistributedArray,
+                  nrows: int) -> DistributedArray:
+        y = DistributedArray(global_shape=nrows * self.M, mesh=x.mesh,
+                             partition=Partition.SCATTER, axis=0,
+                             mask=x.mask, dtype=arr.dtype)
+        y[:] = arr.ravel()
+        return y
+
+
+class _MPIBlockMatrixMult(_MatMulBase):
+    """1-D block variant (ref ``MatrixMult.py:178-427``): A row-sharded
+    over the mesh; forward is comm-free, adjoint is one psum (emitted by
+    the partitioner for the row-contraction)."""
+
+    def _place_A(self, A):
+        from ..parallel.mesh import axis_sharding
+        try:
+            return jax.device_put(A, axis_sharding(self.mesh, 2, 0))
+        except ValueError:
+            return A  # rows not divisible by P: let XLA choose placement
+
+    def _matvec(self, x: DistributedArray) -> DistributedArray:
+        X = x.array.reshape(self.K, self.M)
+        Y = self.A @ X                      # (N, M) row-sharded
+        return self._wrap_out(Y, x, self.N)
+
+    def _rmatvec(self, x: DistributedArray) -> DistributedArray:
+        Y = x.array.reshape(self.N, self.M)
+        At = self.At if self.At is not None else jnp.conj(self.A).T
+        X = At @ Y                          # contraction over sharded N → psum
+        return self._wrap_out(X, x, self.K)
+
+
+class _MPISummaMatrixMult(_MatMulBase):
+    """2-D SUMMA variant (ref ``MatrixMult.py:430-765``) as an explicit
+    shard_map kernel over an (r, c) mesh."""
+
+    def __init__(self, A, M: int, mesh=None, dtype=None, saveAt: bool = False,
+                 grid: Optional[Tuple[int, int]] = None):
+        base = mesh if mesh is not None else default_mesh()
+        ndev = int(base.devices.size)
+        self.grid = grid if grid is not None else best_grid_2d(ndev)
+        self.mesh2 = Mesh(base.devices.reshape(self.grid), ("r", "c"))
+        super().__init__(A, M, mesh=base, dtype=dtype, saveAt=saveAt)
+        pr, pc = self.grid
+        # padded tile sizes (ref pads to grid multiples, MatrixMult.py:589-601)
+        self.Np = pr * int(np.ceil(self.N / pr))
+        self.Kp_r = pr * int(np.ceil(self.K / pr))
+        self.Kp_c = pc * int(np.ceil(self.K / pc))
+        self.Mp = pc * int(np.ceil(self.M / pc))
+
+    def _place_A(self, A):
+        return A  # padded+tiled lazily per apply (kept logical here)
+
+    def _kernel_fwd(self, Ablk, Xblk):
+        # Ablk: (Np/pr, Kp_c/pc) tile; Xblk: (Kp_r... ) — gather full
+        # row of A along 'c' and full column of X along 'r', one GEMM.
+        Arow = lax.all_gather(Ablk, "c", axis=1, tiled=True)   # (Np/pr, Kp_c)
+        Xcol = lax.all_gather(Xblk, "r", axis=0, tiled=True)   # (Kp_r, Mp/pc)
+        return Arow[:, :self.K] @ Xcol[:self.K]
+
+    def _kernel_adj(self, Ablk, Yblk):
+        # X = Aᴴ Y, contraction over N which is sharded on 'r': gather Y
+        # tiles along 'c' (full M for this row-block), one local GEMM
+        # against the owned A tile, then psum the partial K-block over
+        # 'r'. The reference's tagged-p2p Aᴴ pipeline (ref
+        # MatrixMult.py:744-761) becomes gather + reduce.
+        Yrow = lax.all_gather(Yblk, "c", axis=1, tiled=True)   # (Np/pr, Mp)
+        part = jnp.conj(Ablk).T @ Yrow                         # (Kp_c/pc, Mp)
+        return lax.psum(part, "r")
+
+    def _matvec(self, x: DistributedArray) -> DistributedArray:
+        pr, pc = self.grid
+        X = _pad_to(x.array.reshape(self.K, self.M), self.Kp_r, self.Mp)
+        Ap = _pad_to(jnp.asarray(self.A), self.Np, self.Kp_c)
+        Y = shard_map(self._kernel_fwd, mesh=self.mesh2,
+                      in_specs=(P("r", "c"), P("r", "c")),
+                      out_specs=P("r", "c"), check_vma=False)(Ap, X)
+        return self._wrap_out(Y[:self.N, :self.M], x, self.N)
+
+    def _rmatvec(self, x: DistributedArray) -> DistributedArray:
+        Y = _pad_to(x.array.reshape(self.N, self.M), self.Np, self.Mp)
+        Ap = _pad_to(jnp.asarray(self.A), self.Np, self.Kp_c)
+        X = shard_map(self._kernel_adj, mesh=self.mesh2,
+                      in_specs=(P("r", "c"), P("r", "c")),
+                      out_specs=P("c", None), check_vma=False)(Ap, Y)
+        return self._wrap_out(X[:self.K, :self.M], x, self.K)
+
+
+class _MPIAutoMatrixMult(_MatMulBase):
+    """Partitioner-derived schedule: 2-D tiling expressed only as
+    sharding constraints on one einsum (SURVEY §3.4: 'let XLA derive
+    SUMMA')."""
+
+    def __init__(self, A, M: int, mesh=None, dtype=None, saveAt: bool = False,
+                 grid: Optional[Tuple[int, int]] = None):
+        base = mesh if mesh is not None else default_mesh()
+        self.grid = grid if grid is not None else best_grid_2d(int(base.devices.size))
+        self.mesh2 = Mesh(base.devices.reshape(self.grid), ("r", "c"))
+        super().__init__(A, M, mesh=base, dtype=dtype, saveAt=saveAt)
+
+    def _place_A(self, A):
+        try:
+            return jax.device_put(A, NamedSharding(self.mesh2, P("r", "c")))
+        except ValueError:
+            return A  # non-divisible tiles: leave placement to XLA
+
+    def _matvec(self, x: DistributedArray) -> DistributedArray:
+        X = x.array.reshape(self.K, self.M)
+        Y = jnp.einsum("nk,km->nm", self.A, X)
+        return self._wrap_out(Y, x, self.N)
+
+    def _rmatvec(self, x: DistributedArray) -> DistributedArray:
+        Y = x.array.reshape(self.N, self.M)
+        At = self.At if self.At is not None else jnp.conj(self.A).T
+        X = jnp.einsum("kn,nm->km", At, Y)
+        return self._wrap_out(X, x, self.K)
+
+
+def MPIMatrixMult(A, M: int, saveAt: bool = False, mesh=None,
+                  kind: str = "summa", dtype=None,
+                  grid: Optional[Tuple[int, int]] = None) -> MPILinearOperator:
+    """Factory (ref ``MatrixMult.py:768-872``): ``kind`` in
+    {"block", "summa", "auto"}.
+
+    Parameters mirror the reference, except ``A`` is the full global
+    matrix (one controller) rather than this rank's block.
+    """
+    if kind == "block":
+        return _MPIBlockMatrixMult(A, M, mesh=mesh, dtype=dtype, saveAt=saveAt)
+    if kind == "summa":
+        return _MPISummaMatrixMult(A, M, mesh=mesh, dtype=dtype,
+                                   saveAt=saveAt, grid=grid)
+    if kind == "auto":
+        return _MPIAutoMatrixMult(A, M, mesh=mesh, dtype=dtype,
+                                  saveAt=saveAt, grid=grid)
+    raise NotImplementedError("kind must be 'block', 'summa' or 'auto'")

@@ -1,0 +1,63 @@
+"""Multi-dimensional convolution (MDC).
+
+Rebuild of ``pylops_mpi/waveeqprocessing/MDC.py:12-180``: the lazy chain
+``F1ᴴ · I1ᴴ · Fredholm1 · I · F`` where F/F1 are real FFTs along time
+applied to the replicated model/data (wrapped local operators,
+ref ``MDC.py:55-58``), I/I1 slice to the first ``nfmax`` frequencies,
+and the frequency-sharded :class:`MPIFredholm1` is the distributed core.
+Kernel prescaling ``dr·dt·√nt`` (ref ``MDC.py:37-43``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..linearoperator import MPILinearOperator, aslinearoperator
+from .fredholm import MPIFredholm1
+from .local import FFT as _LocalFFT, Identity as _LocalIdentity
+
+__all__ = ["MPIMDC"]
+
+
+def MPIMDC(G, nt: int, nv: int, nfreq: Optional[int] = None, dt: float = 1.0,
+           dr: float = 1.0, twosided: bool = True, saveGt: bool = True,
+           conj: bool = False, prescaled: bool = False, mesh=None
+           ) -> MPILinearOperator:
+    """Distributed MDC operator (ref ``MDC.py:82-180``). ``G`` is the
+    full frequency-domain kernel ``(nfmax, ns, nr)`` (one controller —
+    the reference passes each rank its frequency chunk)."""
+    G = jnp.asarray(G)
+    if twosided and nt % 2 == 0:
+        raise ValueError("nt must be odd number")
+    dtype = G.dtype
+    rdtype = np.real(np.ones(1, dtype=dtype)).dtype
+    nfmax, ns, nr = G.shape
+    nfft = int(np.ceil((nt + 1) / 2))
+    nfmax_req = nfmax if nfreq is None else nfreq
+    if nfmax_req > nfft:
+        nfmax_req = nfft
+        logging.warning("nfmax set equal to ceil[(nt+1)/2]=%d" % nfft)
+    if nfmax_req != nfmax:
+        G = G[:nfmax_req]
+        nfmax = nfmax_req
+
+    scale = 1.0 if prescaled else dr * dt * np.sqrt(nt)
+    Frop = MPIFredholm1(scale * G, nv, saveGt=saveGt, mesh=mesh, dtype=dtype)
+    if conj:
+        Frop = Frop.conj()
+
+    Fop = aslinearoperator(_LocalFFT((nt, nr, nv), axis=0, real=True,
+                                     ifftshift_before=twosided, dtype=rdtype))
+    F1op = aslinearoperator(_LocalFFT((nt, ns, nv), axis=0, real=True,
+                                      ifftshift_before=False, dtype=rdtype))
+    Iop = aslinearoperator(_LocalIdentity(nfmax * nr * nv, nfft * nr * nv,
+                                          dtype=dtype))
+    I1op = aslinearoperator(_LocalIdentity(nfmax * ns * nv, nfft * ns * nv,
+                                           dtype=dtype))
+    MDCop = F1op.H * I1op.H * Frop * Iop * Fop
+    MDCop.dtype = rdtype
+    return MDCop

@@ -1,0 +1,5 @@
+"""Namespace parity with ``pylops_mpi.optimization``."""
+from ..solvers.basic import CG, CGLS, cg, cgls
+from ..solvers.sparsity import ISTA, FISTA, ista, fista
+from ..solvers.eigs import power_iteration
+from ..solvers import basic, sparsity, eigs

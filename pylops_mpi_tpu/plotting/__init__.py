@@ -1,0 +1,1 @@
+from .plotting import plot_distributed_array, plot_local_arrays

@@ -1,0 +1,4 @@
+"""Namespace parity with ``pylops_mpi.signalprocessing``."""
+from ..ops.fft import MPIFFTND, MPIFFT2D
+from ..ops.fredholm import MPIFredholm1
+from ..ops.nonstatconv import MPINonStationaryConvolve1D

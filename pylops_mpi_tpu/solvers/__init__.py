@@ -1,1 +1,3 @@
 from .basic import CG, CGLS, cg, cgls
+from .sparsity import ISTA, FISTA, ista, fista
+from .eigs import power_iteration

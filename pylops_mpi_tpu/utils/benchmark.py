@@ -1,0 +1,144 @@
+"""Benchmark / tracing utility.
+
+Rebuild of ``pylops_mpi/utils/benchmark.py:25-173``: a ``@benchmark``
+decorator plus in-function ``mark(label)`` region markers with a
+nested-call stack and tree-formatted output. The reference barrier-syncs
+all MPI ranks and device-syncs CUDA before each ``perf_counter``
+(ref ``_sync``, ``benchmark.py:70-73``); here synchronisation is
+``jax.block_until_ready`` on the values observed so far (one controller
+— no barrier needed), and a ``jax.profiler`` trace can be attached for
+XLA-level inspection. Disabled globally by ``BENCH_PYLOPS_MPI=0``
+(ref ``benchmark.py:25``; the same kill-switch name is honoured, plus
+``BENCH_PYLOPS_MPI_TPU``).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import time
+from contextlib import contextmanager
+from typing import Callable, List, Optional
+
+import jax
+
+__all__ = ["benchmark", "mark", "profile_trace"]
+
+
+def _enabled() -> bool:
+    flag = os.getenv("BENCH_PYLOPS_MPI_TPU",
+                     os.getenv("BENCH_PYLOPS_MPI", "1"))
+    return int(flag) == 1
+
+
+# Stack of active mark functions (nested benchmark support,
+# ref benchmark.py:27-29)
+_mark_func_stack: List[Callable] = []
+_markers: List = []
+
+
+def _sync(values=()) -> None:
+    """Block until outstanding device work is done (the analog of the
+    reference's Barrier + CUDA device sync)."""
+    for v in values:
+        try:
+            jax.block_until_ready(v)
+        except Exception:
+            pass
+    jax.effects_barrier()
+
+
+def mark(label: str, *values) -> None:
+    """Region marker (ref ``benchmark.py:76-90``): ends the previous
+    region and starts a new one. Optional ``values`` are block-waited to
+    attribute asynchronous device work to the right region."""
+    if not _enabled():
+        return
+    if not _mark_func_stack:
+        raise RuntimeError("mark() called outside of a benchmarked region")
+    _sync(values)
+    _mark_func_stack[-1](label)
+
+
+def _parse_output_tree(markers) -> List[str]:
+    """ref ``benchmark.py:33-67``"""
+    output = []
+    stack: List = []
+    i = 0
+    while i < len(markers):
+        label, t, level = markers[i]
+        if label.startswith("[decorator]"):
+            indent = "\t" * (level - 1)
+            output.append(f"{indent}{label}: total runtime: {t:6f} s\n")
+        else:
+            if stack:
+                prev_label, prev_time, prev_level = stack[-1]
+                if prev_level == level:
+                    indent = "\t" * level
+                    output.append(
+                        f"{indent}{prev_label}-->{label}: {t - prev_time:6f} s\n")
+                    stack.pop()
+            if i + 1 <= len(markers) - 1:
+                _, _, next_level = markers[i + 1]
+                if next_level >= level:
+                    stack.append(markers[i])
+        i += 1
+    return output
+
+
+def benchmark(func: Optional[Callable] = None, description: str = "",
+              logger: Optional[logging.Logger] = None):
+    """Decorator measuring start-to-end runtime with nested ``mark``
+    support (ref ``benchmark.py:92-173``)."""
+
+    def noop_decorator(f):
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            return f(*args, **kwargs)
+        return wrapped
+
+    def actual_decorator(f):
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            global _markers
+            level = len(_mark_func_stack) + 1
+
+            def local_mark(label):
+                _markers.append((label, time.perf_counter(), level))
+
+            _mark_func_stack.append(local_mark)
+            desc = description or f.__name__
+            _sync()
+            t0 = time.perf_counter()
+            out = f(*args, **kwargs)
+            _sync((out,))
+            t1 = time.perf_counter()
+            _mark_func_stack.pop()
+            _markers.append((f"[decorator] {desc}", t1 - t0, level))
+            if not _mark_func_stack:
+                text = "".join(_parse_output_tree(_markers))
+                _markers = []
+                if logger is not None:
+                    logger.info("\n" + text)
+                else:
+                    print(text, end="")
+            return out
+        return wrapped
+
+    if not _enabled():
+        return noop_decorator if func is None else noop_decorator(func)
+    if func is not None:
+        return actual_decorator(func)
+    return actual_decorator
+
+
+@contextmanager
+def profile_trace(logdir: str):
+    """Attach a ``jax.profiler`` trace around a region — the XLA-level
+    view the reference cannot offer (TensorBoard-compatible)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,41 @@
+"""Distributed fftshift helpers.
+
+Rebuild of ``pylops_mpi/utils/fft_helper.py:11-105``: the reference
+rolls local axes locally and redistributes to roll the sharded axis;
+here a shift is one ``jnp.roll`` on the logical global array — the
+partitioner emits whatever permute is needed for the sharded axis.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..distributedarray import DistributedArray
+
+__all__ = ["fftshift_nd", "ifftshift_nd"]
+
+
+def _shift(x: DistributedArray, axes, inverse: bool) -> DistributedArray:
+    axes = tuple(int(a) for a in np.atleast_1d(axes))
+    g = x.array
+    g = jnp.fft.ifftshift(g, axes=axes) if inverse else \
+        jnp.fft.fftshift(g, axes=axes)
+    out = DistributedArray(global_shape=x.global_shape, mesh=x.mesh,
+                           partition=x.partition, axis=x.axis,
+                           local_shapes=x.local_shapes, mask=x.mask,
+                           dtype=x.dtype)
+    out[:] = g
+    return out
+
+
+def fftshift_nd(x: DistributedArray, axes=None) -> DistributedArray:
+    axes = tuple(range(x.ndim)) if axes is None else axes
+    return _shift(x, axes, inverse=False)
+
+
+def ifftshift_nd(x: DistributedArray, axes=None) -> DistributedArray:
+    axes = tuple(range(x.ndim)) if axes is None else axes
+    return _shift(x, axes, inverse=True)

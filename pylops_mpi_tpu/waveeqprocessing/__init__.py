@@ -1,0 +1,2 @@
+"""Namespace parity with ``pylops_mpi.waveeqprocessing``."""
+from ..ops.mdc import MPIMDC

@@ -1,0 +1,119 @@
+"""Distributed FFT tests — oracle against numpy.fft (the role mpi4py-fft
+plays for the reference's tests)."""
+
+import numpy as np
+import pytest
+
+from pylops_mpi_tpu import DistributedArray, MPIFFTND, MPIFFT2D, dottest
+from pylops_mpi_tpu.utils import fftshift_nd, ifftshift_nd
+
+
+@pytest.mark.parametrize("dims,axes", [((16, 8), (0, 1)), ((8, 16), (0, 1)),
+                                       ((16, 8, 4), (0, 1, 2)),
+                                       ((16, 8, 4), (1, 2)),
+                                       ((8, 6), (1,))])
+def test_fftnd_complex_forward(rng, dims, axes):
+    x = rng.standard_normal(dims) + 1j * rng.standard_normal(dims)
+    Fop = MPIFFTND(dims, axes=axes, dtype=np.complex128)
+    dx = DistributedArray.to_dist(x.ravel())
+    got = Fop.matvec(dx).asarray().reshape(Fop.dimsd_nd)
+    expected = np.fft.fftn(x, axes=axes)
+    np.testing.assert_allclose(got, expected, rtol=1e-10, atol=1e-10)
+
+
+def test_fftnd_adjoint_norm_none(rng):
+    """norm='none': forward unnormalized, adjoint is the true adjoint
+    (N·ifft) — complex dot test must pass."""
+    dims = (16, 8)
+    Fop = MPIFFTND(dims, axes=(0, 1), dtype=np.complex128)
+    u = DistributedArray.to_dist(
+        rng.standard_normal(np.prod(dims))
+        + 1j * rng.standard_normal(np.prod(dims)))
+    v = DistributedArray.to_dist(
+        rng.standard_normal(Fop.shape[0])
+        + 1j * rng.standard_normal(Fop.shape[0]))
+    dottest(Fop, u, v)
+
+
+def test_fftnd_norm_1n_roundtrip(rng):
+    dims = (8, 8)
+    Fop = MPIFFTND(dims, axes=(0, 1), norm="1/n", dtype=np.complex128)
+    x = rng.standard_normal(np.prod(dims)) + 1j * rng.standard_normal(np.prod(dims))
+    dx = DistributedArray.to_dist(x)
+    y = Fop.matvec(dx)
+    # forward = fft/N; adjoint (norm 1/n) = ifft, so the round-trip is x/N
+    back = Fop.rmatvec(y).asarray()
+    np.testing.assert_allclose(back, x / np.prod(dims), rtol=1e-10,
+                               atol=1e-12)
+
+
+def test_fftnd_real(rng):
+    """real=True halves the last transformed axis and applies the √2
+    scaling (ref FFTND.py:278-309)."""
+    dims = (16, 8)
+    Fop = MPIFFTND(dims, axes=(0, 1), real=True, dtype=np.float64)
+    assert Fop.dimsd_nd == (16, 5)
+    x = rng.standard_normal(dims)
+    dx = DistributedArray.to_dist(x.ravel())
+    got = Fop.matvec(dx).asarray().reshape(16, 5)
+    expected = np.fft.rfftn(x, axes=(0, 1))
+    expected[:, 1:1 + (8 - 1) // 2] *= np.sqrt(2)
+    np.testing.assert_allclose(got, expected, rtol=1e-10, atol=1e-10)
+    # real-linear dot test (real parts)
+    u = rng.standard_normal(np.prod(dims))
+    v = (rng.standard_normal(Fop.shape[0])
+         + 1j * rng.standard_normal(Fop.shape[0]))
+    du = DistributedArray.to_dist(u)
+    dv = DistributedArray.to_dist(v)
+    yy = np.vdot(Fop.matvec(du).asarray(), dv.asarray())
+    xx = np.vdot(du.asarray(), Fop.rmatvec(dv).asarray())
+    np.testing.assert_allclose(yy.real, xx.real, rtol=1e-10)
+
+
+def test_fftnd_shifts(rng):
+    dims = (9, 8)
+    Fop = MPIFFTND(dims, axes=(0, 1), ifftshift_before=(True, False),
+                   fftshift_after=(False, True), dtype=np.complex128)
+    x = rng.standard_normal(dims) + 1j * rng.standard_normal(dims)
+    dx = DistributedArray.to_dist(x.ravel())
+    got = Fop.matvec(dx).asarray().reshape(Fop.dimsd_nd)
+    expected = np.fft.fftshift(
+        np.fft.fftn(np.fft.ifftshift(x, axes=0), axes=(0, 1)), axes=1)
+    np.testing.assert_allclose(got, expected, rtol=1e-10, atol=1e-10)
+
+
+def test_fft2d(rng):
+    dims = (16, 16)
+    Fop = MPIFFT2D(dims, dtype=np.complex128)
+    x = rng.standard_normal(dims) + 1j * rng.standard_normal(dims)
+    dx = DistributedArray.to_dist(x.ravel())
+    np.testing.assert_allclose(
+        Fop.matvec(dx).asarray().reshape(dims), np.fft.fft2(x),
+        rtol=1e-10, atol=1e-10)
+    with pytest.raises(ValueError):
+        MPIFFT2D(dims, axes=(0, 1, 2))
+
+
+def test_fftnd_nfft_padding(rng):
+    dims = (8, 6)
+    Fop = MPIFFTND(dims, axes=(0, 1), nffts=(16, 8), dtype=np.complex128)
+    assert Fop.dimsd_nd == (16, 8)
+    x = rng.standard_normal(dims) + 1j * rng.standard_normal(dims)
+    dx = DistributedArray.to_dist(x.ravel())
+    got = Fop.matvec(dx).asarray().reshape(16, 8)
+    np.testing.assert_allclose(got, np.fft.fftn(x, s=(16, 8), axes=(0, 1)),
+                               rtol=1e-10, atol=1e-10)
+    u = DistributedArray.to_dist(
+        rng.standard_normal(48) + 1j * rng.standard_normal(48))
+    v = DistributedArray.to_dist(
+        rng.standard_normal(128) + 1j * rng.standard_normal(128))
+    dottest(Fop, u, v)
+
+
+def test_fftshift_helpers(rng):
+    x = rng.standard_normal((8, 6))
+    dx = DistributedArray.to_dist(x, axis=0)
+    np.testing.assert_allclose(fftshift_nd(dx, axes=0).asarray(),
+                               np.fft.fftshift(x, axes=0))
+    np.testing.assert_allclose(ifftshift_nd(dx, axes=(0, 1)).asarray(),
+                               np.fft.ifftshift(x, axes=(0, 1)))

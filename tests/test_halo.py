@@ -1,0 +1,155 @@
+"""MPIHalo + MPINonStationaryConvolve1D tests — oracle pattern of the
+reference's halo/nonstatconv tests: distributed sandwich vs serial
+global operator."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from pylops_mpi_tpu import (DistributedArray, Partition, MPIHalo,
+                            MPIBlockDiag, MPINonStationaryConvolve1D,
+                            halo_block_split)
+from pylops_mpi_tpu.ops.local import NonStationaryConvolve1D, Conv1D
+
+
+def _block_flat(x_nd, grid):
+    """Flatten an N-D array in rank-major Cartesian block order (the
+    layout of MPIHalo's model vector)."""
+    parts, sizes = [], []
+    n = int(np.prod(grid))
+    for r in range(n):
+        sl = halo_block_split(x_nd.shape, r, grid)
+        blk = x_nd[sl]
+        parts.append(blk.ravel())
+        sizes.append((blk.size,))
+    return np.concatenate(parts), sizes
+
+
+def test_halo_block_split():
+    sl = halo_block_split((16,), 3, (8,))
+    assert sl == (slice(6, 8),)
+    sl = halo_block_split((10, 12), 5, (2, 4))
+    assert sl == (slice(5, 10), slice(3, 6))
+
+
+@pytest.mark.parametrize("halo", [1, 2])
+def test_halo_1d_scalar(rng, halo):
+    """Scalar halo is trimmed at grid boundaries (ref Halo.py:204-210)."""
+    n = 24
+    x = rng.standard_normal(n)
+    Hop = MPIHalo(dims=n, halo=halo, dtype=np.float64)
+    dx = DistributedArray.to_dist(x)  # even split == block split for 1-D
+    y = Hop.matvec(dx)
+    # oracle: each block extended with neighbour rows, one-sided at edges
+    sizes = [3 if i in (0, 7) else 3 + (0 if halo == 0 else 0) for i in range(8)]
+    locs = y.local_arrays()
+    offs = np.arange(0, n + 1, 3)
+    for i in range(8):
+        lo = max(0, offs[i] - (halo if i > 0 else 0))
+        hi = min(n, offs[i + 1] + (halo if i < 7 else 0))
+        np.testing.assert_allclose(locs[i], x[lo:hi])
+    # adjoint crops back
+    z = Hop.rmatvec(y)
+    np.testing.assert_allclose(z.asarray(), x)
+
+
+def test_halo_1d_tuple_zero_boundary(rng):
+    """Tuple halo keeps boundary zones, zero-filled (ref Halo.py:216-227)."""
+    n = 16
+    x = rng.standard_normal(n)
+    Hop = MPIHalo(dims=n, halo=(1,), dtype=np.float64)
+    dx = DistributedArray.to_dist(x)
+    locs = Hop.matvec(dx).local_arrays()
+    np.testing.assert_allclose(locs[0], np.concatenate([[0], x[:3]]))
+    np.testing.assert_allclose(locs[7], np.concatenate([x[13:], [0]]))
+
+
+def test_halo_2d_grid(rng):
+    """2-D Cartesian grid with diagonal corners (the relay pattern of
+    ref Halo.py:320-360)."""
+    dims = (8, 8)
+    grid = (2, 4)
+    x = rng.standard_normal(dims)
+    flat, sizes = _block_flat(x, grid)
+    Hop = MPIHalo(dims=dims, halo=1, proc_grid_shape=grid, dtype=np.float64)
+    dx = DistributedArray.to_dist(flat, local_shapes=sizes)
+    y = Hop.matvec(dx)
+    locs = y.local_arrays()
+    for r in range(8):
+        sl = halo_block_split(dims, r, grid)
+        i, j = np.unravel_index(r, grid)
+        lo0 = sl[0].start - (1 if i > 0 else 0)
+        hi0 = sl[0].stop + (1 if i < 1 else 0)
+        lo1 = sl[1].start - (1 if j > 0 else 0)
+        hi1 = sl[1].stop + (1 if j < 3 else 0)
+        expected = x[lo0:hi0, lo1:hi1]
+        np.testing.assert_allclose(locs[r].reshape(expected.shape), expected)
+    z = Hop.rmatvec(y)
+    np.testing.assert_allclose(z.asarray(), flat)
+
+
+def test_halo_sandwich_conv(rng):
+    """The design use: HOp.H @ BlockDiag(local conv) @ HOp equals the
+    global convolution (ref NonStatConvolve1d.py:139-188 idiom)."""
+    n = 32
+    h = rng.standard_normal(5)
+    x = rng.standard_normal(n)
+    Hop = MPIHalo(dims=n, halo=2, dtype=np.float64)
+    sizes = [int(np.prod(e)) for e in Hop.extents]
+    cops = [Conv1D((s,), h, offset=2, dtype=np.float64) for s in sizes]
+    Op = Hop.H @ MPIBlockDiag(cops) @ Hop
+    dx = DistributedArray.to_dist(x)
+    got = Op.matvec(dx).asarray()
+    expected = np.asarray(Conv1D((n,), h, offset=2,
+                                 dtype=np.float64).matvec(jnp.asarray(x)))
+    np.testing.assert_allclose(got, expected, rtol=1e-12)
+
+
+def test_halo_validates_width():
+    with pytest.raises(ValueError, match="halo width exceeds"):
+        MPIHalo(dims=16, halo=3, dtype=np.float64)  # blocks of 2 < halo 3
+
+
+def test_local_nonstatconv_oracle(rng):
+    """Local op matches a brute-force spreading implementation."""
+    n, nh = 16, 5
+    hs = rng.standard_normal((4, nh))
+    ih = np.array([2, 6, 10, 14])
+    op = NonStationaryConvolve1D((n,), hs, ih, dtype=np.float64)
+    x = rng.standard_normal(n)
+    y = np.asarray(op.matvec(jnp.asarray(x)))
+    # brute force
+    expected = np.zeros(n)
+    Hmat = np.asarray(op.Hbank)
+    for i in range(n):
+        for j in range(nh):
+            k = i - nh // 2 + j
+            if 0 <= k < n:
+                expected[k] += Hmat[i, j] * x[i]
+    np.testing.assert_allclose(y, expected, rtol=1e-12)
+    # adjoint dot test
+    u = rng.standard_normal(n)
+    v = rng.standard_normal(n)
+    np.testing.assert_allclose(
+        np.vdot(np.asarray(op.matvec(jnp.asarray(u))), v),
+        np.vdot(u, np.asarray(op.rmatvec(jnp.asarray(v)))), rtol=1e-10)
+
+
+def test_distributed_nonstatconv(rng):
+    """Distributed factory equals the serial global operator
+    (ref tests' oracle pattern)."""
+    n = 64
+    nh = 5
+    hs = rng.standard_normal((16, nh))
+    ih = np.arange(2, 64, 4)
+    Op = MPINonStationaryConvolve1D(n, hs, ih, dtype=np.float64)
+    serial = NonStationaryConvolve1D((n,), hs, ih, dtype=np.float64)
+    x = rng.standard_normal(n)
+    dx = DistributedArray.to_dist(x)
+    np.testing.assert_allclose(Op.matvec(dx).asarray(),
+                               np.asarray(serial.matvec(jnp.asarray(x))),
+                               rtol=1e-10)
+    dy = DistributedArray.to_dist(rng.standard_normal(n))
+    np.testing.assert_allclose(Op.rmatvec(dy).asarray(),
+                               np.asarray(serial.rmatvec(dy.asarray())),
+                               rtol=1e-10)

@@ -1,0 +1,69 @@
+"""Application pipeline tests (poststack, mdd) — the reference validates
+these via tutorial smoke runs under mpiexec; here they are real tests."""
+
+import numpy as np
+import pytest
+
+from pylops_mpi_tpu.models import (PoststackLinearModelling,
+                                   MPIPoststackLinearModelling,
+                                   poststack_inversion, ricker, mdd,
+                                   kernel_to_frequency)
+from pylops_mpi_tpu import DistributedArray
+import jax.numpy as jnp
+
+
+def test_ricker():
+    w, t = ricker(np.arange(0, 0.04, 0.004), f0=20)
+    assert w.shape == t.shape
+    assert np.argmax(w) == len(w) // 2
+
+
+def test_poststack_forward_oracle(rng):
+    """Local modelling equals explicit 0.5*conv(deriv) computation."""
+    nt0 = 32
+    wav, _ = ricker(np.arange(0, 0.02, 0.002), f0=30)
+    op = PoststackLinearModelling(wav, nt0, dtype=np.float64)
+    m = rng.standard_normal(nt0)
+    got = np.asarray(op.matvec(jnp.asarray(m)))
+    dm = np.zeros(nt0)
+    dm[1:-1] = 0.5 * (m[2:] - m[:-2])
+    dm[0] = m[1] - m[0]
+    dm[-1] = m[-1] - m[-2]
+    full = np.convolve(dm, wav)
+    expected = 0.5 * full[len(wav) // 2: len(wav) // 2 + nt0]
+    np.testing.assert_allclose(got, expected, rtol=1e-10)
+
+
+@pytest.mark.parametrize("epsR", [None, 0.01])
+def test_poststack_inversion(rng, epsR):
+    nx, nt0 = 16, 64
+    wav, _ = ricker(np.arange(0, 0.02, 0.002), f0=25)
+    # smooth impedance model
+    m = np.cumsum(rng.standard_normal((nx, nt0)) * 0.05, axis=1)
+    Op = MPIPoststackLinearModelling(wav, nt0, nx)
+    dm = DistributedArray.to_dist(m.ravel(), local_shapes=Op.local_shapes_m)
+    d = Op.matvec(dm).asarray().reshape(nx, nt0)
+    minv, _ = poststack_inversion(d, wav, niter=150, epsR=epsR,
+                                  damp=1e-3)
+    # modelling operator has a null space (constant per trace); compare
+    # through the forward operator instead of the model directly
+    dminv = DistributedArray.to_dist(minv.ravel(),
+                                     local_shapes=Op.local_shapes_m)
+    dre = Op.matvec(dminv).asarray().reshape(nx, nt0)
+    assert np.linalg.norm(dre - d) / np.linalg.norm(d) < 5e-2
+
+
+def test_mdd_roundtrip(rng):
+    """mdd() recovers the model that generated the data."""
+    ns, nr, nt, nv = 4, 3, 17, 1
+    Gt = rng.standard_normal((ns, nr, nt)) * np.exp(
+        -0.3 * np.arange(nt))[None, None, :]
+    G = kernel_to_frequency(Gt)
+    from pylops_mpi_tpu import MPIMDC
+    from pylops_mpi_tpu.distributedarray import Partition
+    Op = MPIMDC(G, nt=nt, nv=nv, twosided=True)
+    xtrue = rng.standard_normal(nt * nr * nv)
+    d = Op.matvec(DistributedArray.to_dist(
+        xtrue, partition=Partition.BROADCAST)).asarray().reshape(nt, ns, nv)
+    minv, _ = mdd(G, d, nt=nt, nv=nv, niter=300)
+    np.testing.assert_allclose(minv.ravel(), xtrue, rtol=1e-3, atol=1e-5)

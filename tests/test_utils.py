@@ -1,0 +1,113 @@
+"""Benchmark utility + checkpoint/resume tests (reference aux subsystems,
+SURVEY §5; checkpointing is new functionality the reference lacks)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import pylops_mpi_tpu as pmt
+from pylops_mpi_tpu import DistributedArray, CGLS, MPIBlockDiag
+from pylops_mpi_tpu.ops.local import MatrixMult
+from pylops_mpi_tpu.utils import (benchmark, mark, save_solver, load_solver,
+                                  save_pytree, load_pytree)
+
+
+def test_benchmark_decorator(capsys):
+    @benchmark
+    def work():
+        mark("phase-a")
+        s = sum(range(1000))
+        mark("phase-b")
+        return s
+
+    assert work() == 499500
+    out = capsys.readouterr().out
+    assert "[decorator] work" in out
+    assert "phase-a-->phase-b" in out
+
+
+def test_benchmark_nested(capsys):
+    @benchmark(description="inner")
+    def inner():
+        return 1
+
+    @benchmark(description="outer")
+    def outer():
+        return inner() + 1
+
+    assert outer() == 2
+    out = capsys.readouterr().out
+    assert "inner" in out and "outer" in out
+
+
+def test_benchmark_disabled(capsys, monkeypatch):
+    monkeypatch.setenv("BENCH_PYLOPS_MPI_TPU", "0")
+
+    @benchmark
+    def work():
+        return 7
+
+    assert work() == 7
+    assert capsys.readouterr().out == ""
+
+
+def test_mark_outside_raises():
+    with pytest.raises(RuntimeError):
+        mark("orphan")
+
+
+def test_pytree_roundtrip(tmp_path, rng):
+    x = DistributedArray.to_dist(rng.standard_normal(24))
+    st = pmt.StackedDistributedArray([x, x.copy()])
+    path = str(tmp_path / "state.pkl")
+    save_pytree(path, {"x": x, "st": st, "k": 3.5, "a": np.arange(4)})
+    got = load_pytree(path)
+    np.testing.assert_allclose(got["x"].asarray(), x.asarray())
+    np.testing.assert_allclose(got["st"].asarray(), st.asarray())
+    assert got["k"] == 3.5
+
+
+def test_solver_checkpoint_resume(tmp_path, rng):
+    """Snapshot CGLS mid-run, resume in a fresh solver, match the
+    uninterrupted solve."""
+    mats = []
+    for _ in range(8):
+        a = rng.standard_normal((6, 6))
+        mats.append(a @ a.T + 6 * np.eye(6))
+    Op = MPIBlockDiag([MatrixMult(m, dtype=np.float64) for m in mats])
+    y = DistributedArray.to_dist(rng.standard_normal(48))
+    x0 = DistributedArray.to_dist(np.zeros(48))
+
+    # uninterrupted
+    ref_solver = CGLS(Op)
+    xr = ref_solver.setup(y, x0, niter=20, tol=0)
+    xr = ref_solver.run(xr, 20)
+
+    # interrupted at iteration 7
+    s1 = CGLS(Op)
+    x = s1.setup(y, x0, niter=20, tol=0)
+    for _ in range(7):
+        x = s1.step(x)
+    path = str(tmp_path / "cgls.ckpt")
+    save_solver(path, s1, x=x)
+
+    s2 = CGLS(Op)
+    x2 = load_solver(path, s2)
+    assert s2.iiter == 7
+    while s2.iiter < 20:
+        x2 = s2.step(x2)
+    np.testing.assert_allclose(x2.asarray(), xr.asarray(), rtol=1e-10)
+
+
+def test_solver_checkpoint_wrong_class(tmp_path, rng):
+    Op = MPIBlockDiag([MatrixMult(np.eye(2), dtype=np.float64)
+                       for _ in range(8)])
+    y = DistributedArray.to_dist(np.ones(16))
+    s = CGLS(Op)
+    x = s.setup(y, y.zeros_like(), niter=2)
+    path = str(tmp_path / "c.ckpt")
+    save_solver(path, s, x=x)
+    from pylops_mpi_tpu import CG
+    with pytest.raises(ValueError, match="checkpoint is for"):
+        load_solver(path, CG(Op))
