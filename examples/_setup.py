@@ -1,0 +1,18 @@
+"""Shared example bootstrap: run on the real TPU if present, else on a
+simulated 8-device CPU mesh (the reference needs ``mpiexec -n 8``; here
+one process drives the mesh)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if os.environ.get("PYLOPS_MPI_TPU_PLATFORM", "cpu") == "cpu":
+    os.environ.setdefault(
+        "XLA_FLAGS",
+        (os.environ.get("XLA_FLAGS", "")
+         + " --xla_force_host_platform_device_count=8").strip())
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+else:
+    import jax  # noqa: F401

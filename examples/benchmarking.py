@@ -1,0 +1,26 @@
+"""Benchmark utility walkthrough — analog of the reference's
+``tutorials/benchmarking.py``."""
+import _setup  # noqa: F401
+import numpy as np
+import pylops_mpi_tpu as pmt
+from pylops_mpi_tpu.utils import benchmark, mark
+from pylops_mpi_tpu.ops.local import MatrixMult
+
+rng = np.random.default_rng(0)
+ndev = int(pmt.default_mesh().devices.size)
+blocks = [rng.standard_normal((256, 256)) for _ in range(ndev)]
+Op = pmt.MPIBlockDiag([MatrixMult(b, dtype=np.float64) for b in blocks])
+x = pmt.DistributedArray.to_dist(rng.standard_normal(Op.shape[1]))
+
+
+@benchmark(description="matvec+rmatvec pipeline")
+def pipeline(v):
+    mark("start forward")
+    y = Op.matvec(v)
+    mark("forward done", y.array)
+    z = Op.rmatvec(y)
+    mark("adjoint done", z.array)
+    return z
+
+
+pipeline(x)

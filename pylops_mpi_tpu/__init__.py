@@ -12,6 +12,7 @@ from .parallel.mesh import (
 )
 from .distributedarray import DistributedArray
 from .stacked import StackedDistributedArray
+from .stackedlinearoperator import MPIStackedLinearOperator
 from .linearoperator import (
     MPILinearOperator, LinearOperator, aslinearoperator, asmpilinearoperator,
 )

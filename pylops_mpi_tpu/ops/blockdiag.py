@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ..distributedarray import DistributedArray, Partition
 from ..stacked import StackedDistributedArray
 from ..linearoperator import MPILinearOperator
+from ..stackedlinearoperator import MPIStackedLinearOperator
 from .local import LocalOperator, MatrixMult
 
 __all__ = ["MPIBlockDiag", "MPIStackedBlockDiag"]
@@ -127,7 +128,7 @@ class MPIBlockDiag(MPILinearOperator):
         return self._apply(x, forward=False)
 
 
-class MPIStackedBlockDiag(MPILinearOperator):
+class MPIStackedBlockDiag(MPIStackedLinearOperator):
     """Diagonal stack of distributed operators acting on a
     StackedDistributedArray (ref ``BlockDiag.py:147-188``)."""
 

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from ..distributedarray import DistributedArray, Partition
 from ..stacked import StackedDistributedArray
 from ..linearoperator import MPILinearOperator
+from ..stackedlinearoperator import MPIStackedLinearOperator
 from .local import LocalOperator
 
 __all__ = ["MPIVStack", "MPIStackedVStack", "MPIHStack"]
@@ -75,7 +76,7 @@ class MPIVStack(MPILinearOperator):
         return y
 
 
-class MPIStackedVStack(MPILinearOperator):
+class MPIStackedVStack(MPIStackedLinearOperator):
     """Vertical stack of distributed operators: one shared model, stacked
     data (ref ``VStack.py:153-203``). Output is a StackedDistributedArray
     with one component per operator."""

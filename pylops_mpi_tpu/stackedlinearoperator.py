@@ -1,0 +1,34 @@
+"""Stacked linear-operator ABC.
+
+Rebuild of ``pylops_mpi/StackedLinearOperator.py:15-568``: the abstract
+base for operators whose model and/or data are
+:class:`StackedDistributedArray`s, with the same lazy algebra as
+:class:`MPILinearOperator`. Here the two hierarchies share one base —
+the algebra wrappers compose either vector type — so this class only
+adds the reference's composition guards (product forbids stacking
+incompatibilities, ref ``StackedLinearOperator.py:430-443``).
+"""
+
+from __future__ import annotations
+
+from .linearoperator import (MPILinearOperator, _ProductLinearOperator,
+                             _ScaledLinearOperator)
+
+__all__ = ["MPIStackedLinearOperator"]
+
+
+class MPIStackedLinearOperator(MPILinearOperator):
+    """Abstract operator over stacked model/data spaces
+    (ref ``StackedLinearOperator.py:15-387``)."""
+
+    def dot(self, x):
+        from .ops.stack import MPIStackedVStack
+        if isinstance(x, MPIStackedLinearOperator) or \
+                isinstance(x, MPILinearOperator):
+            # the reference forbids VStack @ VStack and mismatched
+            # BlockDiag products (StackedLinearOperator.py:430-443)
+            if isinstance(self, MPIStackedVStack) and \
+                    isinstance(x, MPIStackedVStack):
+                raise ValueError(
+                    "cannot multiply two MPIStackedVStack operators")
+        return super().dot(x)

@@ -1,0 +1,54 @@
+"""Operator decorators.
+
+Rebuild of ``pylops_mpi/utils/decorators.py:9-86``. The reference's
+``reshaped`` rebalances an arbitrarily-sharded flat input to the
+operator's expected per-rank N-D shapes with ghost-cell transfers
+computed from cumulative shard-size differences, reshapes, applies, and
+re-ravels (redistributing to axis 0 first). On a mesh the rebalancing is
+a logical-view repack (XLA schedules any movement), so the decorator
+reduces to: flat → N-D DistributedArray sharded on axis 0 → wrapped
+``_matvec`` → shard-major ravel.
+
+Provided for users writing custom operators whose inner logic wants the
+N-D layout; the built-in operators inline this.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+from ..distributedarray import DistributedArray, Partition
+
+__all__ = ["reshaped"]
+
+
+def reshaped(func=None, forward: Optional[bool] = None,
+             stacking: bool = False):
+    """Decorate an ``_matvec``/``_rmatvec`` so it receives an N-D
+    DistributedArray shaped per ``self.dims``/``self.dimsd`` and its
+    return value is flattened back (ref ``decorators.py:9-86``)."""
+
+    def decorator(f):
+        fwd = forward if forward is not None else \
+            f.__name__.endswith("matvec") and "r" not in f.__name__[:2]
+
+        @functools.wraps(f)
+        def wrapper(self, x: DistributedArray):
+            dims = self.dims if fwd else self.dimsd
+            dims = tuple(int(d) for d in np.atleast_1d(dims))
+            nd = DistributedArray(global_shape=dims, mesh=x.mesh,
+                                  partition=Partition.SCATTER, axis=0,
+                                  mask=x.mask, dtype=x.dtype)
+            nd[:] = x.array.reshape(dims)
+            y = f(self, nd)
+            if isinstance(y, DistributedArray) and y.ndim > 1:
+                y = y.redistribute(0).ravel() if y.axis != 0 else y.ravel()
+            return y
+        return wrapper
+
+    if func is not None:
+        return decorator(func)
+    return decorator

@@ -1,0 +1,51 @@
+"""Feature flags / environment configuration.
+
+Rebuild of ``pylops_mpi/utils/deps.py:1-66``. The reference's flags pick
+between MPI, CUDA-aware MPI and NCCL backends at import time
+(``NCCL_PYLOPS_MPI``, ``PYLOPS_MPI_CUDA_AWARE``). The TPU build has one
+backend — XLA collectives — so the seam carries different switches:
+
+- ``PYLOPS_MPI_TPU_PLATFORM``: force ``jax_platforms`` (e.g. ``cpu``
+  for the 8-virtual-device simulation) before first backend use.
+- ``PYLOPS_MPI_TPU_X64``: enable float64 (defaults to JAX's setting;
+  TPUs prefer f32/bf16).
+- ``BENCH_PYLOPS_MPI`` / ``BENCH_PYLOPS_MPI_TPU``: benchmark kill-switch
+  (ref ``utils/benchmark.py:25``; both names honoured).
+- ``TEST_CUPY_PYLOPS`` has no analog (no CuPy engine); kept as a no-op
+  recognised name so reference test-harness scripts don't break.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["jax_enabled", "platform_override", "x64_enabled",
+           "apply_environment"]
+
+jax_enabled = True  # the only engine; mirrors deps.nccl_enabled's role
+
+
+def platform_override():
+    return os.environ.get("PYLOPS_MPI_TPU_PLATFORM")
+
+
+def x64_enabled() -> bool:
+    return os.environ.get("PYLOPS_MPI_TPU_X64", "0") == "1"
+
+
+_applied = False
+
+
+def apply_environment() -> None:
+    """Apply env-flag configuration to JAX (idempotent; call before any
+    jnp op if overriding the platform)."""
+    global _applied
+    if _applied:
+        return
+    import jax
+    plat = platform_override()
+    if plat:
+        jax.config.update("jax_platforms", plat)
+    if x64_enabled():
+        jax.config.update("jax_enable_x64", True)
+    _applied = True

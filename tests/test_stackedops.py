@@ -1,0 +1,100 @@
+"""MPIStackedLinearOperator algebra + reshaped decorator + deps flags —
+mirrors the reference's ``tests/test_stackedlinearop.py`` patterns."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import pylops_mpi_tpu as pmt
+from pylops_mpi_tpu import (DistributedArray, StackedDistributedArray,
+                            MPIBlockDiag, MPIStackedVStack,
+                            MPIStackedBlockDiag, MPIStackedLinearOperator)
+from pylops_mpi_tpu.ops.local import MatrixMult
+from pylops_mpi_tpu.utils.decorators import reshaped
+
+
+def _bd(rng, bm=4, bn=4):
+    mats = [rng.standard_normal((bm, bn)) for _ in range(8)]
+    return MPIBlockDiag([MatrixMult(m, dtype=np.float64) for m in mats]), mats
+
+
+def test_stacked_blockdiag(rng):
+    Op1, m1 = _bd(rng)
+    Op2, m2 = _bd(rng, 3, 5)
+    S = MPIStackedBlockDiag([Op1, Op2])
+    assert isinstance(S, MPIStackedLinearOperator)
+    x1 = DistributedArray.to_dist(rng.standard_normal(Op1.shape[1]))
+    x2 = DistributedArray.to_dist(rng.standard_normal(Op2.shape[1]))
+    xs = StackedDistributedArray([x1, x2])
+    y = S.matvec(xs)
+    np.testing.assert_allclose(y[0].asarray(),
+                               Op1.matvec(x1).asarray(), rtol=1e-12)
+    np.testing.assert_allclose(y[1].asarray(),
+                               Op2.matvec(x2).asarray(), rtol=1e-12)
+    # adjoint + algebra on stacked operators
+    z = S.H.matvec(y)
+    np.testing.assert_allclose(z[0].asarray(),
+                               Op1.rmatvec(y[0]).asarray(), rtol=1e-12)
+    S2 = 2.0 * S
+    y2 = S2.matvec(xs)
+    np.testing.assert_allclose(y2[0].asarray(), 2 * y[0].asarray(),
+                               rtol=1e-12)
+
+
+def test_stacked_vstack_product_forbidden(rng):
+    Op1, _ = _bd(rng)
+    V1 = MPIStackedVStack([Op1, Op1])
+    V2 = MPIStackedVStack([Op1, Op1])
+    with pytest.raises(ValueError, match="cannot multiply two"):
+        V1 @ V2
+
+
+def test_stacked_solver_roundtrip(rng):
+    """CG on a normal-equations stacked operator (ref test_solver
+    stacked parametrizations)."""
+    Op1, _ = _bd(rng)
+    V = MPIStackedVStack([Op1, 0.5 * Op1])
+    x = DistributedArray.to_dist(rng.standard_normal(Op1.shape[1]))
+    y = V.matvec(x)
+    NormalOp = V.H @ V
+    rhs = V.rmatvec(y)
+    xi, iiter, cost = pmt.cg(NormalOp, rhs, x.zeros_like(), niter=300,
+                             tol=1e-13)
+    np.testing.assert_allclose(xi.asarray(), x.asarray(), rtol=1e-5,
+                               atol=1e-7)
+
+
+def test_reshaped_decorator(rng):
+    """Custom operator using @reshaped receives the N-D layout."""
+
+    class Scale2D(pmt.MPILinearOperator):
+        def __init__(self, dims):
+            self.dims = dims
+            self.dimsd = dims
+            n = int(np.prod(dims))
+            super().__init__(shape=(n, n), dtype=np.float64)
+
+        @reshaped(forward=True)
+        def _matvec(self, x):
+            assert x.ndim == 2
+            return x * 2.0
+
+        @reshaped(forward=False)
+        def _rmatvec(self, x):
+            assert x.ndim == 2
+            return x * 2.0
+
+    op = Scale2D((8, 4))
+    x = rng.standard_normal(32)
+    dx = DistributedArray.to_dist(x)
+    np.testing.assert_allclose(op.matvec(dx).asarray(), 2 * x, rtol=1e-12)
+    assert op.matvec(dx).global_shape == (32,)
+
+
+def test_deps_flags(monkeypatch):
+    from pylops_mpi_tpu.utils import deps
+    assert deps.jax_enabled
+    monkeypatch.setenv("PYLOPS_MPI_TPU_PLATFORM", "cpu")
+    assert deps.platform_override() == "cpu"
+    monkeypatch.setenv("PYLOPS_MPI_TPU_X64", "1")
+    assert deps.x64_enabled()
