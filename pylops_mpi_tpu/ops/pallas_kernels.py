@@ -1,0 +1,106 @@
+"""Pallas TPU kernels for the stencil hot loops.
+
+The reference's hot stencil path is ghost-cell exchange + NumPy slicing
+per rank (SURVEY §3.3). Here the default path is already a fused XLA
+stencil; this module adds hand-written Pallas kernels for the
+first/second-derivative inner loops so the shift+subtract+scale chain is
+a single VMEM pass instead of several HLO slices — useful when the
+operator is applied standalone (XLA fuses it into neighbours anyway when
+composed).
+
+Kernels run natively on TPU; on CPU they fall back to ``interpret=True``
+(tests) or the plain jnp formulation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+__all__ = ["first_derivative_centered", "second_derivative",
+           "pallas_available"]
+
+
+def pallas_available() -> bool:
+    if not _HAS_PALLAS:
+        return False
+    plat = jax.default_backend()
+    return plat in ("tpu", "cpu")
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fd_kernel(x_ref, o_ref, *, inv2s: float):
+    """y[i] = (x[i+1] - x[i-1]) * inv2s on rows 1..n-2, zero edges.
+    The row axis is the sublane axis; one VMEM pass."""
+    x = x_ref[:]
+    n = x.shape[0]
+    # pltpu.roll requires non-negative shifts: roll(-1) == roll(n-1)
+    up = pltpu.roll(x, n - 1, 0)
+    dn = pltpu.roll(x, 1, 0)
+    y = (up - dn) * inv2s
+    row = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    o_ref[:] = jnp.where((row >= 1) & (row <= n - 2), y, 0.0)
+
+
+def _sd_kernel(x_ref, o_ref, *, invs2: float):
+    x = x_ref[:]
+    n = x.shape[0]
+    up = pltpu.roll(x, n - 1, 0)
+    dn = pltpu.roll(x, 1, 0)
+    y = (up - 2.0 * x + dn) * invs2
+    row = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+    o_ref[:] = jnp.where((row >= 1) & (row <= n - 2), y, 0.0)
+
+
+def _call(kernel, x2d: jax.Array) -> jax.Array:
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(x2d)
+
+
+def first_derivative_centered(x: jax.Array, axis: int = 0,
+                              sampling: float = 1.0) -> jax.Array:
+    """Centered 3-point first derivative along ``axis`` (edge rows zero,
+    pylops ``edge=False``), as one Pallas VMEM pass."""
+    if not pallas_available():
+        v = jnp.moveaxis(x, axis, 0)
+        mid = (v[2:] - v[:-2]) / (2 * sampling)
+        y = jnp.pad(mid, [(1, 1)] + [(0, 0)] * (v.ndim - 1))
+        return jnp.moveaxis(y, 0, axis)
+    v = jnp.moveaxis(x, axis, 0)
+    shp = v.shape
+    v2 = v.reshape(shp[0], -1)
+    y2 = _call(partial(_fd_kernel, inv2s=1.0 / (2.0 * sampling)), v2)
+    return jnp.moveaxis(y2.reshape(shp), 0, axis)
+
+
+def second_derivative(x: jax.Array, axis: int = 0,
+                      sampling: float = 1.0) -> jax.Array:
+    """3-point second derivative along ``axis`` as one Pallas pass."""
+    if not pallas_available():
+        v = jnp.moveaxis(x, axis, 0)
+        mid = (v[2:] - 2 * v[1:-1] + v[:-2]) / sampling ** 2
+        y = jnp.pad(mid, [(1, 1)] + [(0, 0)] * (v.ndim - 1))
+        return jnp.moveaxis(y, 0, axis)
+    v = jnp.moveaxis(x, axis, 0)
+    shp = v.shape
+    v2 = v.reshape(shp[0], -1)
+    y2 = _call(partial(_sd_kernel, invs2=1.0 / sampling ** 2), v2)
+    return jnp.moveaxis(y2.reshape(shp), 0, axis)

@@ -30,6 +30,7 @@ __all__ = [
     "allgather",
     "ppermute_shift",
     "all_to_all_resharding",
+    "ring_halo",
 ]
 
 
@@ -142,3 +143,55 @@ def all_to_all_resharding(x: jax.Array, mesh: Mesh,
 
     return shard_map(kernel, mesh=mesh, in_specs=P(*in_spec),
                      out_specs=P(*out_spec))(x)
+
+
+def ring_halo(x: jax.Array, mesh: Mesh, front: int = 0, back: int = 0):
+    """Explicit ring halo exchange over the sharded axis 0: each shard
+    receives its predecessor's last ``front`` rows and its successor's
+    first ``back`` rows, zero-filled at the domain edges.
+
+    One `ppermute`` hop per direction — the structural analog of ring
+    attention's neighbour pass, and the explicit form of the ghost-cell
+    Send/Recv chain in ref ``pylops_mpi/DistributedArray.py:877-954``
+    (XLA emits the same transfers implicitly for the fused stencils; this
+    primitive exists for hand-scheduled kernels and benchmarks).
+
+    Returns ``(front_ghosts, back_ghosts)``: arrays sharded like ``x``
+    whose per-shard blocks are the ghost rows (``P*front`` / ``P*back``
+    global rows).
+    """
+    axis_name = mesh.axis_names[0]
+    n = int(mesh.devices.size)
+    spec = P(*([axis_name] + [None] * (x.ndim - 1)))
+
+    def kernel(xs):
+        idx = lax.axis_index(axis_name)
+        outs = []
+        if front:
+            fwd = [(i, (i + 1) % n) for i in range(n)]
+            recv = lax.ppermute(xs[-front:], axis_name, fwd)
+            recv = jnp.where(
+                (idx == 0) * jnp.ones((1,) * xs.ndim, dtype=bool),
+                jnp.zeros_like(recv), recv)
+            outs.append(recv)
+        else:
+            outs.append(None)
+        if back:
+            bwd = [(i, (i - 1) % n) for i in range(n)]
+            recv = lax.ppermute(xs[:back], axis_name, bwd)
+            recv = jnp.where(
+                (idx == n - 1) * jnp.ones((1,) * xs.ndim, dtype=bool),
+                jnp.zeros_like(recv), recv)
+            outs.append(recv)
+        else:
+            outs.append(None)
+        return tuple(o for o in outs if o is not None)
+
+    nouts = (1 if front else 0) + (1 if back else 0)
+    out_specs = tuple(spec for _ in range(nouts))
+    res = shard_map(kernel, mesh=mesh, in_specs=spec, out_specs=out_specs,
+                    check_vma=False)(x)
+    res = list(res)
+    fg = res.pop(0) if front else None
+    bg = res.pop(0) if back else None
+    return fg, bg
