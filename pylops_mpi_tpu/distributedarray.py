@@ -301,16 +301,38 @@ class DistributedArray:
         (ref ``DistributedArray.py:408-461``; there every rank holds the
         full ``x`` and slices its shard — here the controller places it
         once with ``jax.device_put``)."""
-        x = jnp.asarray(x)
+        host_src = isinstance(x, np.ndarray)
+        if not host_src:
+            x = jnp.asarray(x)
+        dtype = jax.dtypes.canonicalize_dtype(x.dtype)
         out = cls(global_shape=x.shape, mesh=mesh, partition=partition,
                   axis=axis, local_shapes=local_shapes, mask=mask,
-                  dtype=x.dtype)
-        out._arr = out._place(out._from_global(x))
+                  dtype=dtype)
+        if host_src and not out._even:
+            # Uneven split from a host array: cast to the canonical
+            # dtype first (half the traffic when x64 is off), then pack
+            # to the padded physical layout with the native (C++) host
+            # runtime in one threaded pass instead of tracing per-shard
+            # pad+concat.
+            from . import native
+            phys = native.pack_padded(np.asarray(x, dtype=dtype), out._axis,
+                                      out._axis_sizes, out._s_phys)
+            out._arr = out._place(jnp.asarray(phys))
+        else:
+            out._arr = out._place(out._from_global(jnp.asarray(x)))
         return out
 
     def asarray(self) -> np.ndarray:
         """Gather the global array to host
         (ref ``DistributedArray.py:371-406``)."""
+        if not self._even:
+            # Pull the padded physical buffer once and strip padding on
+            # host with the native runtime (threaded memcpy) rather than
+            # compiling a per-shard slice+concat gather.
+            from . import native
+            phys = np.asarray(jax.device_get(self._arr))
+            return native.unpack_padded(phys, self._axis, self._axis_sizes,
+                                        self._s_phys)
         return np.asarray(jax.device_get(self._global()))
 
     def local_arrays(self) -> List[np.ndarray]:
@@ -376,16 +398,19 @@ class DistributedArray:
         shape[self._axis] = per_index.shape[0]
         return per_index.reshape(shape)
 
+    def _operand_phys(self, x: "DistributedArray") -> jax.Array:
+        """Other-array physical buffer in *this* array's layout. Arrays
+        split differently (axis or shard sizes) repack through the
+        logical view (the reference instead raises — rebalancing is the
+        @reshaped decorator's job there, ref utils/decorators.py:9-86)."""
+        self._check_compat(x)
+        if x._axis != self._axis or x._axis_sizes != self._axis_sizes:
+            return self._from_global(x._global())
+        return x._arr
+
     def _coerce_operand(self, x):
         if isinstance(x, DistributedArray):
-            self._check_compat(x)
-            if x._axis_sizes != self._axis_sizes:
-                # different logical splits of the same global shape:
-                # repack through the logical view (the reference instead
-                # raises — rebalancing is the @reshaped decorator's job
-                # there, ref utils/decorators.py:9-86)
-                return self._from_global(x._global())
-            return x._arr
+            return self._operand_phys(x)
         if isinstance(x, (jax.Array, np.ndarray)) and np.ndim(x) == 1 \
                 and self._mask is not None \
                 and self._partition == Partition.SCATTER \
@@ -479,9 +504,8 @@ class DistributedArray:
         partitioner lowers to ``psum``. With a ``mask``, returns the
         vector of per-group scalars (each reference rank sees only its
         own group's value; here all groups are visible at once)."""
-        self._check_compat(y)
         a = jnp.conj(self._arr) if vdot else self._arr
-        z = a * y._arr
+        z = a * self._operand_phys(y)
         if self._partition != Partition.SCATTER:
             # BROADCAST ignores mask, as the reference's to_dist round-trip
             # in dot does (ref DistributedArray.py:678-682)

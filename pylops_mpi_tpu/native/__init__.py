@@ -1,0 +1,234 @@
+"""First-party native host runtime (C++), with NumPy fallback.
+
+The reference gets its host-path muscle from third-party native code
+(MPI datatype packing, FFTW, CuPy — SURVEY.md §2.6 / ref
+``pyproject.toml:1-8`` shows zero first-party native).  Here the staging
+work around the XLA compute path — padded shard pack/unpack for uneven
+``Partition.SCATTER`` splits (ref ``pylops_mpi/DistributedArray.py:408-461``,
+``371-406``; pad-to-max idiom from ``utils/_nccl.py:363-403``) and
+threaded binary IO for data loading / checkpoints — is first-party C++
+(``src/hostpack.cpp``), compiled on first use with ``g++`` and bound via
+``ctypes``.
+
+Disable with ``PYLOPS_MPI_TPU_NATIVE=0`` (same env-flag seam as the
+reference's ``NCCL_PYLOPS_MPI``, ref ``utils/deps.py:62-64``); every
+entry point transparently falls back to NumPy when the library is
+unavailable (no compiler, unsupported OS).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+import warnings
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["available", "local_split_native", "pack_padded", "unpack_padded",
+           "read_binary", "write_binary", "write_binary_at",
+           "default_threads"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "hostpack.cpp")
+_BUILD_DIR = os.path.join(_HERE, "_build")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _enabled() -> bool:
+    return os.environ.get("PYLOPS_MPI_TPU_NATIVE", "1") != "0"
+
+
+def default_threads() -> int:
+    n = os.environ.get("PYLOPS_MPI_TPU_NATIVE_THREADS")
+    if n:
+        return max(1, int(n))
+    return max(1, min(16, os.cpu_count() or 1))
+
+
+def _build_and_load() -> Optional[ctypes.CDLL]:
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    so = os.path.join(_BUILD_DIR, f"hostpack_{tag}.so")
+    if not os.path.exists(so):
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        tmp = so + f".tmp{os.getpid()}"
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+               _SRC, "-o", tmp]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, so)
+    lib = ctypes.CDLL(so)
+    i64, i32, cp = ctypes.c_int64, ctypes.c_int32, ctypes.c_char_p
+    vp = ctypes.c_void_p
+    lib.lp_local_split.argtypes = [i64, i32, vp]
+    lib.lp_pack_padded.argtypes = [vp, vp, i64, i64, i32, vp, i64, i32]
+    lib.lp_unpack_padded.argtypes = [vp, vp, i64, i64, i32, vp, i64, i32]
+    lib.lp_read_file.argtypes = [cp, i64, i64, vp, i32]
+    lib.lp_read_file.restype = i32
+    lib.lp_write_file.argtypes = [cp, i64, vp, i32]
+    lib.lp_write_file.restype = i32
+    lib.lp_write_file_at.argtypes = [cp, i64, i64, vp, i32]
+    lib.lp_write_file_at.restype = i32
+    return lib
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if not _enabled():
+        return None
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is None and not _tried:
+            _tried = True
+            try:
+                _lib = _build_and_load()
+            except Exception as e:  # no g++, read-only fs, ...
+                warnings.warn(f"native host runtime unavailable, using NumPy "
+                              f"fallback: {e}", stacklevel=2)
+                _lib = None
+    return _lib
+
+
+def available() -> bool:
+    """True when the compiled C++ runtime is loadable."""
+    return _get_lib() is not None
+
+
+# --------------------------------------------------------------- helpers
+def _outer_inner(shape: Sequence[int], axis: int, itemsize: int):
+    outer = int(np.prod(shape[:axis], dtype=np.int64)) if axis else 1
+    inner = int(np.prod(shape[axis + 1:], dtype=np.int64)) * itemsize
+    return outer, inner
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+# ------------------------------------------------------------ public API
+def local_split_native(n: int, nshards: int) -> np.ndarray:
+    """Balanced axis split (ref ``DistributedArray.py:62-71``)."""
+    lib = _get_lib()
+    if lib is None:
+        from ..parallel.partition import Partition, local_split
+        shapes = local_split((int(n),), int(nshards), Partition.SCATTER, 0)
+        return np.asarray([s[0] for s in shapes], dtype=np.int64)
+    out = np.empty(nshards, dtype=np.int64)
+    lib.lp_local_split(int(n), int(nshards), _ptr(out))
+    return out
+
+
+def pack_padded(x: np.ndarray, axis: int, sizes: Sequence[int],
+                s_phys: int, nthreads: Optional[int] = None) -> np.ndarray:
+    """Logical global host array -> padded physical layout: shard ``p``'s
+    rows land at ``[p*s_phys, p*s_phys+sizes[p])`` along ``axis``, the
+    rest zero-filled."""
+    x = np.ascontiguousarray(x)
+    axis = axis % x.ndim
+    sizes = np.ascontiguousarray(sizes, dtype=np.int64)
+    P = len(sizes)
+    shp = list(x.shape)
+    shp[axis] = P * int(s_phys)
+    lib = _get_lib()
+    if lib is None:
+        out = np.zeros(shp, dtype=x.dtype)
+        offs = np.concatenate([[0], np.cumsum(sizes)])
+        for p in range(P):
+            src = [slice(None)] * x.ndim
+            dst = [slice(None)] * x.ndim
+            src[axis] = slice(int(offs[p]), int(offs[p + 1]))
+            dst[axis] = slice(p * s_phys, p * s_phys + int(sizes[p]))
+            out[tuple(dst)] = x[tuple(src)]
+        return out
+    out = np.empty(shp, dtype=x.dtype)
+    outer, inner = _outer_inner(x.shape, axis, x.itemsize)
+    lib.lp_pack_padded(_ptr(x), _ptr(out), outer, inner, P, _ptr(sizes),
+                       int(s_phys), nthreads or default_threads())
+    return out
+
+
+def unpack_padded(x: np.ndarray, axis: int, sizes: Sequence[int],
+                  s_phys: int, nthreads: Optional[int] = None) -> np.ndarray:
+    """Padded physical host array -> logical global (strip padding)."""
+    x = np.ascontiguousarray(x)
+    axis = axis % x.ndim
+    sizes = np.ascontiguousarray(sizes, dtype=np.int64)
+    P = len(sizes)
+    shp = list(x.shape)
+    shp[axis] = int(sizes.sum())
+    lib = _get_lib()
+    if lib is None:
+        parts = []
+        for p in range(P):
+            idx = [slice(None)] * x.ndim
+            idx[axis] = slice(p * s_phys, p * s_phys + int(sizes[p]))
+            parts.append(x[tuple(idx)])
+        return np.concatenate(parts, axis=axis)
+    out = np.empty(shp, dtype=x.dtype)
+    outer, inner = _outer_inner(out.shape, axis, x.itemsize)
+    lib.lp_unpack_padded(_ptr(x), _ptr(out), outer, inner, P, _ptr(sizes),
+                         int(s_phys), nthreads or default_threads())
+    return out
+
+
+def read_binary(path: str, dtype, shape: Sequence[int], *, offset: int = 0,
+                nthreads: Optional[int] = None) -> np.ndarray:
+    """Threaded chunked read of a raw binary volume (data-loader
+    primitive for e.g. seismic cubes, ref ``tutorials/poststack.py``)."""
+    dtype = np.dtype(dtype)
+    out = np.empty(shape, dtype=dtype)
+    nbytes = out.nbytes
+    lib = _get_lib()
+    if lib is None:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read(nbytes)
+        if len(data) != nbytes:
+            raise IOError(f"short read from {path}")
+        out[...] = np.frombuffer(data, dtype=dtype).reshape(shape)
+        return out
+    rc = lib.lp_read_file(path.encode(), int(offset), nbytes, _ptr(out),
+                          nthreads or default_threads())
+    if rc != 0:
+        raise IOError(f"native read of {path} failed (rc={rc})")
+    return out
+
+
+def write_binary(path: str, x: np.ndarray,
+                 nthreads: Optional[int] = None) -> None:
+    """Threaded chunked write (checkpoint-writer primitive)."""
+    x = np.ascontiguousarray(x)
+    lib = _get_lib()
+    if lib is None:
+        with open(path, "wb") as f:
+            f.write(x.tobytes())
+        return
+    rc = lib.lp_write_file(path.encode(), x.nbytes, _ptr(x),
+                           nthreads or default_threads())
+    if rc != 0:
+        raise IOError(f"native write of {path} failed (rc={rc})")
+
+
+def write_binary_at(path: str, offset: int, x: np.ndarray,
+                    nthreads: Optional[int] = None) -> None:
+    """Threaded chunked write of ``x`` at byte ``offset`` (no
+    truncation) — streams several arrays into one file with flat peak
+    host memory."""
+    x = np.ascontiguousarray(x)
+    lib = _get_lib()
+    if lib is None:
+        with open(path, "r+b" if os.path.exists(path) else "wb") as f:
+            f.seek(offset)
+            f.write(x.tobytes())
+        return
+    rc = lib.lp_write_file_at(path.encode(), int(offset), x.nbytes, _ptr(x),
+                              nthreads or default_threads())
+    if rc != 0:
+        raise IOError(f"native write of {path} failed (rc={rc})")

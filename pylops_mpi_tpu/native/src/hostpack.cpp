@@ -1,0 +1,183 @@
+// Native host runtime for pylops-mpi-tpu.
+//
+// The reference (pylops-mpi) leans on third-party native code for its
+// performance-critical host paths (MPI datatype packing inside
+// Allgatherv, mpi4py pickling buffers, FFTW transposes — see
+// SURVEY.md §2.6).  This library is the first-party TPU-build analog:
+// the host-side staging work that happens *around* the XLA compute
+// path — scattering a global host array into the padded per-shard
+// physical layout (``DistributedArray.to_dist``,
+// ref pylops_mpi/DistributedArray.py:408-461), gathering it back
+// (``asarray``, ref DistributedArray.py:371-406), and feeding shards
+// from disk — implemented as multithreaded C++ instead of Python
+// slicing.
+//
+// Layout contract (all arrays C-contiguous, described as
+// (outer, axis, inner_bytes)):
+//   logical  global:  (outer, G,          inner)   G = sum(sizes[p])
+//   physical padded:  (outer, P * s_phys, inner)   shard p occupies rows
+//                     [p*s_phys, p*s_phys + sizes[p]); the remainder is
+//                     zero padding (pad-to-max — the same trick the
+//                     reference's NCCL path uses for ragged allgathers,
+//                     pylops_mpi/utils/_nccl.py:363-403).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+// Run fn(task) for task in [0, ntasks) over nthreads workers.
+void parallel_for(int64_t ntasks, int32_t nthreads,
+                  const std::function<void(int64_t)> &fn) {
+  if (nthreads <= 1 || ntasks <= 1) {
+    for (int64_t t = 0; t < ntasks; ++t) fn(t);
+    return;
+  }
+  std::atomic<int64_t> next(0);
+  auto worker = [&]() {
+    for (;;) {
+      int64_t t = next.fetch_add(1, std::memory_order_relaxed);
+      if (t >= ntasks) return;
+      fn(t);
+    }
+  };
+  std::vector<std::thread> pool;
+  int32_t n = static_cast<int32_t>(std::min<int64_t>(nthreads, ntasks));
+  pool.reserve(n);
+  for (int32_t i = 0; i < n; ++i) pool.emplace_back(worker);
+  for (auto &th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Balanced split of n elements over P shards: first n % P shards get
+// one extra element (ref pylops_mpi/DistributedArray.py:62-71).
+void lp_local_split(int64_t n, int32_t P, int64_t *out_sizes) {
+  int64_t q = n / P, r = n % P;
+  for (int32_t p = 0; p < P; ++p) out_sizes[p] = q + (p < r ? 1 : 0);
+}
+
+// Logical global -> physical padded (pack). Zero-fills padding.
+void lp_pack_padded(const char *src, char *dst, int64_t outer, int64_t inner,
+                    int32_t P, const int64_t *sizes, int64_t s_phys,
+                    int32_t nthreads) {
+  std::vector<int64_t> offs(P + 1, 0);
+  for (int32_t p = 0; p < P; ++p) offs[p + 1] = offs[p] + sizes[p];
+  const int64_t G = offs[P];
+  const int64_t phys_rows = static_cast<int64_t>(P) * s_phys;
+  parallel_for(outer * P, nthreads, [&](int64_t task) {
+    const int64_t o = task / P;
+    const int32_t p = static_cast<int32_t>(task % P);
+    const char *s = src + (o * G + offs[p]) * inner;
+    char *d = dst + (o * phys_rows + p * s_phys) * inner;
+    std::memcpy(d, s, static_cast<size_t>(sizes[p] * inner));
+    const int64_t pad = s_phys - sizes[p];
+    if (pad > 0)
+      std::memset(d + sizes[p] * inner, 0, static_cast<size_t>(pad * inner));
+  });
+}
+
+// Physical padded -> logical global (unpack / strip padding).
+void lp_unpack_padded(const char *src, char *dst, int64_t outer, int64_t inner,
+                      int32_t P, const int64_t *sizes, int64_t s_phys,
+                      int32_t nthreads) {
+  std::vector<int64_t> offs(P + 1, 0);
+  for (int32_t p = 0; p < P; ++p) offs[p + 1] = offs[p] + sizes[p];
+  const int64_t G = offs[P];
+  const int64_t phys_rows = static_cast<int64_t>(P) * s_phys;
+  parallel_for(outer * P, nthreads, [&](int64_t task) {
+    const int64_t o = task / P;
+    const int32_t p = static_cast<int32_t>(task % P);
+    const char *s = src + (o * phys_rows + p * s_phys) * inner;
+    char *d = dst + (o * G + offs[p]) * inner;
+    std::memcpy(d, s, static_cast<size_t>(sizes[p] * inner));
+  });
+}
+
+// Parallel chunked pread of [offset, offset+nbytes) from path into dst.
+// Returns 0 on success, -1 on open failure, -2 on short/failed read.
+// This is the data-loader primitive: tutorials stream multi-GB seismic
+// volumes from disk (ref tutorials/poststack.py) — chunked pread keeps
+// the page-cache + NVMe queue busy from multiple threads.
+int32_t lp_read_file(const char *path, int64_t offset, int64_t nbytes,
+                     char *dst, int32_t nthreads) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  const int64_t chunk = 8 << 20;  // 8 MiB
+  const int64_t ntasks = (nbytes + chunk - 1) / chunk;
+  std::atomic<int32_t> err(0);
+  parallel_for(ntasks, nthreads, [&](int64_t t) {
+    int64_t start = t * chunk;
+    int64_t len = std::min(chunk, nbytes - start);
+    int64_t done = 0;
+    while (done < len) {
+      ssize_t got = pread(fd, dst + start + done, static_cast<size_t>(len - done),
+                          offset + start + done);
+      if (got <= 0) { err.store(-2); return; }
+      done += got;
+    }
+  });
+  close(fd);
+  return err.load();
+}
+
+// Parallel chunked pwrite at an arbitrary offset without truncation —
+// lets a caller stream several arrays into one file with flat peak
+// memory (checkpoint writer, see utils/checkpoint.py).
+int32_t lp_write_file_at(const char *path, int64_t offset, int64_t nbytes,
+                         const char *src, int32_t nthreads) {
+  int fd = open(path, O_WRONLY | O_CREAT, 0644);
+  if (fd < 0) return -1;
+  const int64_t chunk = 8 << 20;
+  const int64_t ntasks = (nbytes + chunk - 1) / chunk;
+  std::atomic<int32_t> err(0);
+  parallel_for(ntasks, nthreads, [&](int64_t t) {
+    int64_t start = t * chunk;
+    int64_t len = std::min(chunk, nbytes - start);
+    int64_t done = 0;
+    while (done < len) {
+      ssize_t put = pwrite(fd, src + start + done, static_cast<size_t>(len - done),
+                           offset + start + done);
+      if (put <= 0) { err.store(-2); return; }
+      done += put;
+    }
+  });
+  close(fd);
+  return err.load();
+}
+
+// Parallel chunked pwrite (checkpoint writer counterpart).
+int32_t lp_write_file(const char *path, int64_t nbytes, const char *src,
+                      int32_t nthreads) {
+  int fd = open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  if (ftruncate(fd, nbytes) != 0) { close(fd); return -1; }
+  const int64_t chunk = 8 << 20;
+  const int64_t ntasks = (nbytes + chunk - 1) / chunk;
+  std::atomic<int32_t> err(0);
+  parallel_for(ntasks, nthreads, [&](int64_t t) {
+    int64_t start = t * chunk;
+    int64_t len = std::min(chunk, nbytes - start);
+    int64_t done = 0;
+    while (done < len) {
+      ssize_t put = pwrite(fd, src + start + done, static_cast<size_t>(len - done),
+                           start + done);
+      if (put <= 0) { err.store(-2); return; }
+      done += put;
+    }
+  });
+  close(fd);
+  return err.load();
+}
+
+}  // extern "C"

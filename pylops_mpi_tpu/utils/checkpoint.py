@@ -56,17 +56,94 @@ def _decode(v, mesh=None):
     return v
 
 
+# Arrays at or above this size are stored in a sidecar blob file written
+# by the native (C++) threaded writer instead of being pickled inline.
+_BLOB_THRESHOLD = 1 << 20
+
+
+def _extract_blobs(v, blobs):
+    if isinstance(v, np.ndarray) and v.nbytes >= _BLOB_THRESHOLD:
+        a = np.ascontiguousarray(v)
+        off = sum(b.nbytes for b in blobs)
+        blobs.append(a)
+        return {"__blob__": True, "offset": off, "dtype": a.dtype.str,
+                "shape": a.shape}
+    if isinstance(v, dict):
+        return {k: _extract_blobs(e, blobs) for k, e in v.items()}
+    if isinstance(v, (list, tuple)):
+        return type(v)(_extract_blobs(e, blobs) for e in v)
+    return v
+
+
+def _restore_blobs(v, blob_buf):
+    if isinstance(v, dict) and v.get("__blob__"):
+        dt = np.dtype(v["dtype"])
+        n = int(np.prod(v["shape"], dtype=np.int64))
+        off = v["offset"]
+        return np.frombuffer(blob_buf, dtype=dt, count=n,
+                             offset=off).reshape(v["shape"]).copy()
+    if isinstance(v, dict):
+        return {k: _restore_blobs(e, blob_buf) for k, e in v.items()}
+    if isinstance(v, (list, tuple)):
+        return type(v)(_restore_blobs(e, blob_buf) for e in v)
+    return v
+
+
 def save_pytree(path: str, tree: Dict[str, Any]) -> None:
-    """Serialize a dict of arrays/DistributedArrays/scalars."""
+    """Serialize a dict of arrays/DistributedArrays/scalars. Large array
+    payloads stream one-by-one (flat peak memory) into a uniquely-named
+    sidecar via the native threaded writer; the pickle references the
+    sidecar by name and is replaced atomically, so a crash mid-save
+    leaves the previous checkpoint pair intact."""
+    import secrets
+    from .. import native
     enc = {k: _encode(v) for k, v in tree.items()}
+    blobs: list = []
+    enc = _extract_blobs(enc, blobs)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "wb") as f:
+    old_blobfile = None
+    if os.path.exists(path):
+        try:
+            with open(path, "rb") as f:
+                old_blobfile = pickle.load(f).get("__blobfile__")
+        except Exception:
+            pass
+    blob_name = None
+    if blobs:
+        blob_name = os.path.basename(path) + ".blobs." + secrets.token_hex(4)
+        blob_path = os.path.join(os.path.dirname(os.path.abspath(path)),
+                                 blob_name)
+        off = 0
+        for b in blobs:
+            native.write_binary_at(blob_path, off, b.view(np.uint8).reshape(-1))
+            off += b.nbytes
+    enc["__blobfile__"] = blob_name
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
         pickle.dump(enc, f)
+    os.replace(tmp, path)
+    if old_blobfile and old_blobfile != blob_name:
+        old = os.path.join(os.path.dirname(os.path.abspath(path)),
+                           old_blobfile)
+        if os.path.exists(old):
+            os.remove(old)
 
 
 def load_pytree(path: str, mesh=None) -> Dict[str, Any]:
+    from .. import native
     with open(path, "rb") as f:
         enc = pickle.load(f)
+    blob_name = enc.pop("__blobfile__", None)
+    if blob_name is not None:
+        blob_path = os.path.join(os.path.dirname(os.path.abspath(path)),
+                                 blob_name)
+        if not os.path.exists(blob_path):
+            raise FileNotFoundError(
+                f"checkpoint sidecar {blob_path!r} is missing — the "
+                f"checkpoint directory must be moved/copied as a whole")
+        nbytes = os.path.getsize(blob_path)
+        blob_buf = native.read_binary(blob_path, np.uint8, (nbytes,))
+        enc = _restore_blobs(enc, blob_buf)
     return {k: _decode(v, mesh) for k, v in enc.items()}
 
 
