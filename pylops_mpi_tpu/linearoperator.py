@@ -97,6 +97,17 @@ class MPILinearOperator:
                                     self.shape[1])
         raise NotImplementedError
 
+    # ------------------------------------------------- normal-equations
+    # ``(u, q) = (Opᴴ Op x, Op x)`` — the CGLS hot pair. The generic
+    # path is two sweeps; operators that can produce both in one memory
+    # pass (e.g. MPIBlockDiag's Pallas kernel) override this and set
+    # ``has_fused_normal``.
+    has_fused_normal = False
+
+    def normal_matvec(self, x: VectorLike):
+        q = self.matvec(x)
+        return self.rmatvec(q), q
+
     # ----------------------------------------------------------- algebra
     def dot(self, x):
         """Operator-operator, operator-scalar or operator-vector product

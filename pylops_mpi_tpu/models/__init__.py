@@ -2,3 +2,4 @@
 from .poststack import (PoststackLinearModelling, MPIPoststackLinearModelling,
                         poststack_inversion, ricker)
 from .mdd import mdd, kernel_to_frequency
+from .lsm import TravelTimeSpray, KirchhoffDemigration, MPILSM, lsm

@@ -60,9 +60,10 @@ class MPIBlockDiag(MPILinearOperator):
 
     def __init__(self, ops: Sequence[LocalOperator],
                  mask: Optional[Sequence[int]] = None,
-                 mesh=None, dtype=None):
+                 mesh=None, dtype=None, compute_dtype=None):
         self.ops = list(ops)
         self.mask = tuple(mask) if mask is not None else None
+        self.compute_dtype = compute_dtype
         from ..parallel.mesh import default_mesh
         self.mesh = mesh if mesh is not None else default_mesh()
         n_shards = int(self.mesh.devices.size)
@@ -82,7 +83,12 @@ class MPIBlockDiag(MPILinearOperator):
         self._batched = self._try_batch()
 
     def _try_batch(self):
-        """Homogeneous MatrixMult blocks → stacked batched GEMM."""
+        """Homogeneous MatrixMult blocks → stacked batched GEMM.
+
+        ``compute_dtype`` (e.g. ``jnp.bfloat16``) re-stores the stacked
+        blocks narrower — on TPU this halves the HBM traffic of the
+        memory-bound matvec (the MXU accumulates in f32 regardless);
+        vectors and reductions stay in the operator dtype."""
         if not all(isinstance(op, MatrixMult) and not op.otherdims
                    for op in self.ops):
             return None
@@ -90,6 +96,8 @@ class MPIBlockDiag(MPILinearOperator):
         if len(shapes) != 1 or len(self.ops) % int(self.mesh.devices.size) != 0:
             return None
         A = jnp.stack([op.A for op in self.ops])  # (nblk, m, n)
+        if self.compute_dtype is not None:
+            A = A.astype(self.compute_dtype)
         from ..parallel.mesh import axis_sharding
         return jax.device_put(A, axis_sharding(self.mesh, 3, 0))
 
@@ -126,6 +134,45 @@ class MPIBlockDiag(MPILinearOperator):
 
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
         return self._apply(x, forward=False)
+
+    @property
+    def has_fused_normal(self) -> bool:
+        from .pallas_kernels import normal_matvec_supported
+        return (self._batched is not None
+                and normal_matvec_supported(self._batched))
+
+    def normal_matvec(self, x: DistributedArray):
+        """``(u, q) = (OpᴴOp x, Op x)`` with ONE memory sweep of the
+        block matrices when batched (Pallas kernel ``_normal_kernel``):
+        each A tile feeds both products while resident in VMEM. Falls
+        back to matvec+rmatvec otherwise."""
+        if not self.has_fused_normal \
+                or jnp.issubdtype(x.dtype, jnp.complexfloating):
+            # complex vectors would be silently truncated by the real
+            # kernel — use the generic two-sweep pair
+            return super().normal_matvec(x)
+        from jax.sharding import PartitionSpec as P
+        from jax import shard_map
+        from .pallas_kernels import batched_normal_matvec
+        A = self._batched
+        nblk, m, n = A.shape
+        X = x.array.reshape(nblk, n)
+        axis = self.mesh.axis_names[0]
+        U, Q = shard_map(batched_normal_matvec, mesh=self.mesh,
+                         in_specs=(P(axis), P(axis)),
+                         out_specs=(P(axis), P(axis)),
+                         check_vma=False)(A, X)
+        u = DistributedArray(global_shape=self.shape[1], mesh=self.mesh,
+                             partition=x.partition, axis=0,
+                             local_shapes=self.local_shapes_m,
+                             mask=self.mask, dtype=U.dtype)
+        u[:] = U.reshape(-1)
+        q = DistributedArray(global_shape=self.shape[0], mesh=self.mesh,
+                             partition=x.partition, axis=0,
+                             local_shapes=self.local_shapes_n,
+                             mask=self.mask, dtype=Q.dtype)
+        q[:] = Q.reshape(-1)
+        return u, q
 
 
 class MPIStackedBlockDiag(MPIStackedLinearOperator):

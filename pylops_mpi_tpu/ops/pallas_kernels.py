@@ -28,6 +28,7 @@ except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
 __all__ = ["first_derivative_centered", "second_derivative",
+           "batched_normal_matvec", "normal_matvec_supported",
            "pallas_available"]
 
 
@@ -104,3 +105,69 @@ def second_derivative(x: jax.Array, axis: int = 0,
     v2 = v.reshape(shp[0], -1)
     y2 = _call(partial(_sd_kernel, invs2=1.0 / sampling ** 2), v2)
     return jnp.moveaxis(y2.reshape(shp), 0, axis)
+
+
+# ------------------------------------------------------- fused normal matvec
+# One HBM sweep of A per CGLS iteration instead of two: within each row
+# tile, t = A_tile @ x feeds u += A_tileᵀ t while the tile is still in
+# VMEM, so q = A x and u = AᵀA x cost a single read of A. This is the
+# solver hot-spot of SURVEY §3.2 (the reference reads its matrix once in
+# matvec and once in rmatvec per iteration, ref cls_basic.py:389-397).
+
+_VMEM_TILE_BYTES = 4 << 20  # A-tile budget (double-buffered by pipeline)
+
+
+def _pick_tile(m: int, n: int, itemsize: int) -> int:
+    for tm in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+        if m % tm == 0 and tm * n * itemsize <= _VMEM_TILE_BYTES:
+            return tm
+    return 1
+
+
+def normal_matvec_supported(A: jax.Array) -> bool:
+    """Pallas path requires real floating blocks (complex dots fall back
+    to the generic two-sweep path)."""
+    return (_HAS_PALLAS and pallas_available() and A.ndim == 3
+            and not jnp.iscomplexobj(A))
+
+
+def _normal_kernel(a_ref, x_ref, u_ref, q_ref):
+    i = pl.program_id(1)
+    acc = jnp.promote_types(a_ref.dtype, jnp.float32)  # f32 acc for bf16/f32
+    a = a_ref[0].astype(acc)                        # (TM, n)
+    x = x_ref[...].astype(acc)                      # (1, n)
+    t = jax.lax.dot_general(a, x, (((1,), (1,)), ((), ())),
+                            preferred_element_type=acc)  # (TM, 1)
+    q_ref[...] = t.T.astype(q_ref.dtype)
+    u = jax.lax.dot_general(t, a, (((0,), (0,)), ((), ())),
+                            preferred_element_type=acc)  # (1, n)
+
+    @pl.when(i == 0)
+    def _():
+        u_ref[...] = jnp.zeros_like(u_ref)
+
+    u_ref[...] += u.astype(u_ref.dtype)
+
+
+def batched_normal_matvec(A: jax.Array, X: jax.Array):
+    """``(u, q) = (AᵀA x, A x)`` per block, reading each ``A`` block once.
+
+    A: ``(nblk, m, n)`` real; X: ``(nblk, n)``. Returns
+    ``u (nblk, n)``, ``q (nblk, m)``. Call per shard (inside shard_map);
+    on CPU runs in interpret mode.
+    """
+    nblk, m, n = A.shape
+    tm = _pick_tile(m, n, max(A.dtype.itemsize, 4))  # bound the f32 copy
+    out_dtype = X.dtype
+    u, q = pl.pallas_call(
+        _normal_kernel,
+        grid=(nblk, m // tm),
+        in_specs=[pl.BlockSpec((1, tm, n), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, n), lambda b, i: (b, 0))],
+        out_specs=[pl.BlockSpec((1, n), lambda b, i: (b, 0)),
+                   pl.BlockSpec((1, tm), lambda b, i: (b, i))],
+        out_shape=[jax.ShapeDtypeStruct((nblk, n), out_dtype),
+                   jax.ShapeDtypeStruct((nblk, m), out_dtype)],
+        interpret=_interpret(),
+    )(A, X)
+    return u, q

@@ -95,19 +95,14 @@ def save_pytree(path: str, tree: Dict[str, Any]) -> None:
     sidecar via the native threaded writer; the pickle references the
     sidecar by name and is replaced atomically, so a crash mid-save
     leaves the previous checkpoint pair intact."""
+    import glob
     import secrets
     from .. import native
     enc = {k: _encode(v) for k, v in tree.items()}
     blobs: list = []
     enc = _extract_blobs(enc, blobs)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    old_blobfile = None
-    if os.path.exists(path):
-        try:
-            with open(path, "rb") as f:
-                old_blobfile = pickle.load(f).get("__blobfile__")
-        except Exception:
-            pass
+    old_sidecars = glob.glob(os.path.abspath(path) + ".blobs.*")
     blob_name = None
     if blobs:
         blob_name = os.path.basename(path) + ".blobs." + secrets.token_hex(4)
@@ -122,10 +117,8 @@ def save_pytree(path: str, tree: Dict[str, Any]) -> None:
     with open(tmp, "wb") as f:
         pickle.dump(enc, f)
     os.replace(tmp, path)
-    if old_blobfile and old_blobfile != blob_name:
-        old = os.path.join(os.path.dirname(os.path.abspath(path)),
-                           old_blobfile)
-        if os.path.exists(old):
+    for old in old_sidecars:
+        if os.path.basename(old) != blob_name and os.path.exists(old):
             os.remove(old)
 
 

@@ -1,0 +1,45 @@
+"""Smoke-run every example script — the analog of the reference's
+``mpi_examples.sh`` loop (ref ``Makefile:91-104``), which runs each
+example under ``mpiexec -n P``. Here all examples share one subprocess
+(one JAX startup) on the simulated 8-device CPU mesh."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+_RUNNER = r"""
+import os, runpy, sys, time
+os.chdir(sys.argv[1])
+failures = []
+for name in sys.argv[2:]:
+    t0 = time.time()
+    try:
+        runpy.run_path(name, run_name="__main__")
+        print(f"[ok] {name} ({time.time()-t0:.1f}s)", flush=True)
+    except SystemExit as e:
+        if e.code not in (None, 0):
+            failures.append((name, f"exit {e.code}"))
+    except Exception as e:
+        failures.append((name, repr(e)))
+        print(f"[FAIL] {name}: {e!r}", flush=True)
+if failures:
+    sys.exit("failed: " + ", ".join(n for n, _ in failures))
+"""
+
+
+@pytest.mark.slow
+def test_all_examples_run():
+    names = sorted(f for f in os.listdir(_EXAMPLES_DIR)
+                   if f.endswith(".py") and not f.startswith("_"))
+    assert len(names) >= 13  # parity: 13 reference examples + tutorials
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYLOPS_MPI_TPU_PLATFORM"] = "cpu"   # _setup.py picks this up
+    res = subprocess.run(
+        [sys.executable, "-c", _RUNNER, _EXAMPLES_DIR, *names],
+        capture_output=True, text=True, timeout=3000, env=env)
+    assert res.returncode == 0, f"\n{res.stdout}\n{res.stderr}"

@@ -67,3 +67,62 @@ def test_mdd_roundtrip(rng):
         xtrue, partition=Partition.BROADCAST)).asarray().reshape(nt, ns, nv)
     minv, _ = mdd(G, d, nt=nt, nv=nv, niter=300)
     np.testing.assert_allclose(minv.ravel(), xtrue, rtol=1e-3, atol=1e-5)
+
+
+# --------------------------------------------------------------- LSM
+def _lsm_geometry():
+    nx, nz = 21, 16
+    dx = 4.0
+    x, z = np.arange(nx) * dx, np.arange(nz) * dx
+    nr, ns = 5, 4
+    recs = np.vstack((np.linspace(2 * dx, (nx - 2) * dx, nr),
+                      8 * np.ones(nr)))
+    srcs = np.vstack((np.linspace(2 * dx, (nx - 2) * dx, ns),
+                      4 * np.ones(ns)))
+    nt = 160
+    t = np.arange(nt) * 0.002
+    wav, _ = ricker(t[:11], f0=25)
+    return z, x, t, srcs, recs, wav, len(wav) // 2
+
+
+def test_kirchhoff_dottest(rng):
+    from pylops_mpi_tpu.models import KirchhoffDemigration
+    z, x, t, srcs, recs, wav, wavc = _lsm_geometry()
+    Kop = KirchhoffDemigration(z, x, t, srcs, recs, 1000.0, wav, wavc,
+                               dtype=np.float64)
+    u = rng.standard_normal(Kop.shape[1])
+    v = rng.standard_normal(Kop.shape[0])
+    lhs = np.asarray(Kop.matvec(jnp.asarray(u))) @ v
+    rhs = u @ np.asarray(Kop.rmatvec(jnp.asarray(v)))
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-10)
+
+
+def test_spray_oracle(rng):
+    """TravelTimeSpray against an explicit dense scatter oracle."""
+    from pylops_mpi_tpu.models import TravelTimeSpray
+    npairs, npix, nt = 3, 7, 12
+    itrav = rng.integers(0, nt + 3, size=(npairs, npix))  # some invalid
+    amp = rng.standard_normal((npairs, npix))
+    op = TravelTimeSpray(itrav, amp, nt, dtype=np.float64)
+    m = rng.standard_normal(npix)
+    dense = np.zeros((npairs, nt))
+    for p in range(npairs):
+        for i in range(npix):
+            if itrav[p, i] < nt:
+                dense[p, itrav[p, i]] += amp[p, i] * m[i]
+    np.testing.assert_allclose(
+        np.asarray(op.matvec(jnp.asarray(m))).reshape(npairs, nt), dense,
+        rtol=1e-12)
+
+
+def test_lsm_inversion_reduces_cost():
+    from pylops_mpi_tpu.models import lsm
+    z, x, t, srcs, recs, wav, wavc = _lsm_geometry()
+    refl = np.zeros((len(z), len(x)))
+    refl[8] = 1.0
+    minv, d, cost = lsm(z, x, t, srcs, recs, 1000.0, wav, wavc, refl,
+                        niter=15, dtype=np.float64)
+    assert minv.shape == refl.shape
+    assert cost[-1] < 0.5 * cost[0]
+    # the interface row should carry the most energy
+    assert np.abs(minv).sum(axis=1).argmax() == 8
