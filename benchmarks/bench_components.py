@@ -1,0 +1,132 @@
+"""Per-component benchmarks for the BASELINE.md driver configs beyond
+the north star: halo/stencil derivative, SUMMA matmul, pencil FFT,
+frequency-sharded Fredholm1 (the MDC core), poststack pipeline.
+
+Each prints one JSON line per config:
+``{"bench": ..., "value": ..., "unit": ..., "shape": ...}``.
+
+Run: ``python benchmarks/bench_components.py [--quick]``
+(CPU: simulated 8-device mesh; TPU: the attached chips.)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+if os.environ.get("PYLOPS_MPI_TPU_PLATFORM", "") == "cpu":
+    os.environ.setdefault(
+        "XLA_FLAGS",
+        (os.environ.get("XLA_FLAGS", "")
+         + " --xla_force_host_platform_device_count=8").strip())
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def _timeit(f, *args, reps: int = 5, inner: int = 10):
+    """Best-of-reps wall time of ``inner`` chained applications."""
+    import jax
+    out = f(*args)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            out = f(*args)
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / inner)
+    return best
+
+
+def _progress(name):
+    print(f"[bench] {name}...", file=sys.stderr, flush=True)
+
+
+def main(quick: bool = False):
+    import jax
+    import pylops_mpi_tpu as pmt
+
+    mesh = pmt.make_mesh()
+    pmt.set_default_mesh(mesh)
+    n_dev = int(mesh.devices.size)
+    scale = 1 if quick else 4
+    rng = np.random.default_rng(0)
+    results = []
+
+    _progress("first_derivative_halo")
+    # 1. halo/stencil: FirstDerivative on a sharded 2-D field
+    nx, ny = 2048 * scale, 512
+    D = pmt.MPIFirstDerivative((nx, ny), kind="centered", dtype=np.float32)
+    x = pmt.DistributedArray.to_dist(
+        rng.standard_normal(nx * ny).astype(np.float32))
+    fn = jax.jit(lambda v: D.matvec(v).array)
+    dt = _timeit(fn, x)
+    results.append({"bench": "first_derivative_halo", "value":
+                    round(nx * ny * 4 * 3 / dt / 1e9, 2), "unit": "GB/s",
+                    "shape": f"{nx}x{ny}x{n_dev}dev"})
+
+    _progress("summa_matmul")
+    # 2. SUMMA dense matmul
+    N = 1024 * scale
+    A = rng.standard_normal((N, N)).astype(np.float32)
+    X = rng.standard_normal((N, 64)).astype(np.float32)
+    Mop = pmt.MPIMatrixMult(A, M=64, kind="summa", dtype=np.float32)
+    xd = pmt.DistributedArray.to_dist(X.ravel())
+    fn = jax.jit(lambda v: Mop.matvec(v).array)
+    dt = _timeit(fn, xd, inner=5)
+    results.append({"bench": "summa_matmul", "value":
+                    round(2 * N * N * 64 / dt / 1e9, 1), "unit": "GFLOP/s",
+                    "shape": f"{N}x{N}@{N}x64"})
+
+    _progress("pencil_fft2d")
+    # 3. pencil FFT with all-to-all reshard
+    nf = (256 * scale, 256)
+    F = pmt.MPIFFTND(nf, axes=(0, 1), dtype=np.complex64)
+    xf = pmt.DistributedArray.to_dist(
+        (rng.standard_normal(nf) + 1j * rng.standard_normal(nf)
+         ).astype(np.complex64).ravel())
+    fn = jax.jit(lambda v: F.matvec(v).array)
+    dt = _timeit(fn, xf, inner=5)
+    flops = 5 * np.prod(nf) * np.log2(np.prod(nf))
+    results.append({"bench": "pencil_fft2d", "value":
+                    round(flops / dt / 1e9, 1), "unit": "GFLOP/s",
+                    "shape": f"{nf[0]}x{nf[1]}"})
+
+    _progress("fredholm1_batched")
+    # 4. Fredholm1 (MDC core): frequency-sharded batched matmul
+    nsl, nx_, ny_ = 8 * n_dev * scale, 64, 64
+    G = rng.standard_normal((nsl, nx_, ny_)).astype(np.float32)
+    Fr = pmt.MPIFredholm1(G, nz=4, dtype=np.float32)
+    xr = pmt.DistributedArray.to_dist(
+        rng.standard_normal(Fr.shape[1]).astype(np.float32),
+        partition=pmt.Partition.BROADCAST)
+    fn = jax.jit(lambda v: Fr.matvec(v).array)
+    dt = _timeit(fn, xr, inner=5)
+    results.append({"bench": "fredholm1_batched", "value":
+                    round(2 * nsl * nx_ * ny_ * 4 / dt / 1e9, 1),
+                    "unit": "GFLOP/s", "shape": f"{nsl}x{nx_}x{ny_}"})
+
+    _progress("poststack_inversion")
+    # 5. poststack end-to-end (modelling + 10-iter CGLS)
+    from pylops_mpi_tpu.models import ricker, poststack_inversion
+    nt0, nxs = 256, 64 * n_dev * scale
+    wav = ricker(np.arange(31) * 0.004, f0=15)[0].astype(np.float32)
+    m = rng.standard_normal((nxs, nt0)).astype(np.float32)
+    t0 = time.perf_counter()
+    poststack_inversion(m, wav, niter=10, dtype=np.float32)
+    dt = time.perf_counter() - t0
+    results.append({"bench": "poststack_inversion", "value":
+                    round(dt, 3), "unit": "s (incl. compile)",
+                    "shape": f"{nxs}x{nt0},10it"})
+
+    for r in results:
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main(quick="--quick" in sys.argv)

@@ -8,7 +8,8 @@ over ICI/DCN; solver loops run on device as ``lax.while_loop``s.
 
 from .parallel.partition import Partition, local_split
 from .parallel.mesh import (
-    make_mesh, make_mesh_2d, default_mesh, set_default_mesh, best_grid_2d,
+    make_mesh, make_mesh_2d, make_mesh_hybrid, initialize_multihost,
+    default_mesh, set_default_mesh, best_grid_2d,
 )
 from .distributedarray import DistributedArray
 from .stacked import StackedDistributedArray

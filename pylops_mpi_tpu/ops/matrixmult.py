@@ -150,9 +150,15 @@ class _MPISummaMatrixMult(_MatMulBase):
         self.Kp_r = pr * int(np.ceil(self.K / pr))
         self.Kp_c = pc * int(np.ceil(self.K / pc))
         self.Mp = pc * int(np.ceil(self.M / pc))
+        # pad + tile A once, eagerly, and commit it to the 2-D mesh:
+        # padding inside the traced apply would make XLA constant-fold a
+        # full copy of A at compile time (very slow for large A)
+        self.Ap = jax.device_put(
+            _pad_to(jnp.asarray(self.A), self.Np, self.Kp_c),
+            NamedSharding(self.mesh2, P("r", "c")))
 
     def _place_A(self, A):
-        return A  # padded+tiled lazily per apply (kept logical here)
+        return A  # logical A kept for todense/debug; Ap is the hot copy
 
     def _kernel_fwd(self, Ablk, Xblk):
         # Ablk: (Np/pr, Kp_c/pc) tile; Xblk: (Kp_r... ) — gather full
@@ -174,18 +180,16 @@ class _MPISummaMatrixMult(_MatMulBase):
     def _matvec(self, x: DistributedArray) -> DistributedArray:
         pr, pc = self.grid
         X = _pad_to(x.array.reshape(self.K, self.M), self.Kp_r, self.Mp)
-        Ap = _pad_to(jnp.asarray(self.A), self.Np, self.Kp_c)
         Y = shard_map(self._kernel_fwd, mesh=self.mesh2,
                       in_specs=(P("r", "c"), P("r", "c")),
-                      out_specs=P("r", "c"), check_vma=False)(Ap, X)
+                      out_specs=P("r", "c"), check_vma=False)(self.Ap, X)
         return self._wrap_out(Y[:self.N, :self.M], x, self.N)
 
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
         Y = _pad_to(x.array.reshape(self.N, self.M), self.Np, self.Mp)
-        Ap = _pad_to(jnp.asarray(self.A), self.Np, self.Kp_c)
         X = shard_map(self._kernel_adj, mesh=self.mesh2,
                       in_specs=(P("r", "c"), P("r", "c")),
-                      out_specs=P("c", None), check_vma=False)(Ap, Y)
+                      out_specs=P("c", None), check_vma=False)(self.Ap, Y)
         return self._wrap_out(X[:self.K, :self.M], x, self.K)
 
 

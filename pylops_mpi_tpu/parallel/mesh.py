@@ -26,6 +26,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     "make_mesh",
     "make_mesh_2d",
+    "make_mesh_hybrid",
+    "initialize_multihost",
     "default_mesh",
     "set_default_mesh",
     "local_device_count",
@@ -91,6 +93,48 @@ def make_mesh_2d(
     if pr * pc != n_devices:
         raise ValueError(f"grid {grid} does not tile {n_devices} devices")
     return Mesh(np.asarray(devs[:n_devices]).reshape(pr, pc), axis_names)
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Join a multi-host TPU job (DCN-connected slices / pods).
+
+    The analog of the reference's ``mpiexec -n P`` bootstrap + NCCL
+    unique-id handshake (``pylops_mpi/utils/_nccl.py:98-132``): each host
+    calls this once before building meshes; afterwards ``jax.devices()``
+    spans every host and all collectives ride ICI within a slice and DCN
+    across slices. Arguments default to the standard cluster env vars
+    (``jax.distributed.initialize`` auto-detection on TPU pods)."""
+    import jax.distributed
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def make_mesh_hybrid(ici_axis: str = SP_AXIS, dcn_axis: str = "dcn",
+                     dcn_size: Optional[int] = None) -> Mesh:
+    """2-level mesh for multi-slice jobs: the inner axis maps to ICI
+    (fast, within a slice), the outer to DCN (across slices).
+
+    Shard the long/data axis over ``dcn_axis`` and the compute-heavy
+    axis over ``ici_axis`` so the frequent collectives (halo ppermute,
+    SUMMA bcast, dot psum) stay on ICI — the scaling-book layout recipe.
+    Falls back to a 1-level mesh when there is a single process."""
+    nproc = jax.process_count()
+    if dcn_size is None:
+        dcn_size = nproc
+    devs = jax.devices()
+    if dcn_size <= 1:
+        return Mesh(np.asarray(devs).reshape(1, -1), (dcn_axis, ici_axis))
+    try:
+        from jax.experimental import mesh_utils
+        arr = mesh_utils.create_hybrid_device_mesh(
+            (1, len(devs) // dcn_size), (dcn_size, 1), devices=devs)
+        arr = arr.reshape(dcn_size, -1)
+    except Exception:  # non-TPU topologies: plain contiguous split
+        arr = np.asarray(devs).reshape(dcn_size, -1)
+    return Mesh(arr, (dcn_axis, ici_axis))
 
 
 def default_mesh() -> Mesh:

@@ -103,3 +103,18 @@ def test_ring_halo_stencil_equivalence(mesh, rng):
     # interior shard boundaries must match exactly; domain edges use the
     # zero ghosts (row 0 and row 31 differ by design)
     np.testing.assert_allclose(got[1:-1], expected[1:-1], rtol=1e-12)
+
+
+def test_make_mesh_hybrid_single_host():
+    """Single-process fallback: (1, n_devices) 2-level mesh with the
+    DCN axis degenerate; ICI-axis sharding still works end to end."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from pylops_mpi_tpu import make_mesh_hybrid
+    mesh = make_mesh_hybrid()
+    assert mesh.axis_names == ("dcn", "sp")
+    assert mesh.devices.shape == (1, len(jax.devices()))
+    x = jnp.arange(16.0).reshape(8, 2)
+    xs = jax.device_put(x, NamedSharding(mesh, P("sp", None)))
+    np.testing.assert_allclose(np.asarray(jnp.sum(xs, axis=0)),
+                               np.asarray(x).sum(axis=0))
