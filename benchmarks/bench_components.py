@@ -2,10 +2,12 @@
 the north star: halo/stencil derivative, SUMMA matmul, pencil FFT,
 frequency-sharded Fredholm1 (the MDC core), poststack pipeline.
 
-Each prints one JSON line per config:
-``{"bench": ..., "value": ..., "unit": ..., "shape": ...}``.
-
-Run: ``python benchmarks/bench_components.py [--quick]``
+``run_components()`` returns one dict per config
+(``{"bench": ..., "value": ..., "unit": ..., "shape": ...}``), each
+individually try/except-guarded so a single failing config records an
+``"error"`` entry instead of killing the rest; ``bench.py`` embeds the
+list in its JSON artifact. Run standalone:
+``python benchmarks/bench_components.py [--quick]``
 (CPU: simulated 8-device mesh; TPU: the attached chips.)
 """
 
@@ -16,14 +18,6 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
-
-if os.environ.get("PYLOPS_MPI_TPU_PLATFORM", "") == "cpu":
-    os.environ.setdefault(
-        "XLA_FLAGS",
-        (os.environ.get("XLA_FLAGS", "")
-         + " --xla_force_host_platform_device_count=8").strip())
-    import jax
-    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 
@@ -47,31 +41,21 @@ def _progress(name):
     print(f"[bench] {name}...", file=sys.stderr, flush=True)
 
 
-def main(quick: bool = False):
+def _bench_first_derivative(pmt, rng, n_dev, scale):
     import jax
-    import pylops_mpi_tpu as pmt
-
-    mesh = pmt.make_mesh()
-    pmt.set_default_mesh(mesh)
-    n_dev = int(mesh.devices.size)
-    scale = 1 if quick else 4
-    rng = np.random.default_rng(0)
-    results = []
-
-    _progress("first_derivative_halo")
-    # 1. halo/stencil: FirstDerivative on a sharded 2-D field
     nx, ny = 2048 * scale, 512
     D = pmt.MPIFirstDerivative((nx, ny), kind="centered", dtype=np.float32)
     x = pmt.DistributedArray.to_dist(
         rng.standard_normal(nx * ny).astype(np.float32))
     fn = jax.jit(lambda v: D.matvec(v).array)
     dt = _timeit(fn, x)
-    results.append({"bench": "first_derivative_halo", "value":
-                    round(nx * ny * 4 * 3 / dt / 1e9, 2), "unit": "GB/s",
-                    "shape": f"{nx}x{ny}x{n_dev}dev"})
+    return {"bench": "first_derivative_halo",
+            "value": round(nx * ny * 4 * 3 / dt / 1e9, 2), "unit": "GB/s",
+            "shape": f"{nx}x{ny}x{n_dev}dev"}
 
-    _progress("summa_matmul")
-    # 2. SUMMA dense matmul
+
+def _bench_summa(pmt, rng, n_dev, scale):
+    import jax
     N = 1024 * scale
     A = rng.standard_normal((N, N)).astype(np.float32)
     X = rng.standard_normal((N, 64)).astype(np.float32)
@@ -79,12 +63,13 @@ def main(quick: bool = False):
     xd = pmt.DistributedArray.to_dist(X.ravel())
     fn = jax.jit(lambda v: Mop.matvec(v).array)
     dt = _timeit(fn, xd, inner=5)
-    results.append({"bench": "summa_matmul", "value":
-                    round(2 * N * N * 64 / dt / 1e9, 1), "unit": "GFLOP/s",
-                    "shape": f"{N}x{N}@{N}x64"})
+    return {"bench": "summa_matmul",
+            "value": round(2 * N * N * 64 / dt / 1e9, 1), "unit": "GFLOP/s",
+            "shape": f"{N}x{N}@{N}x64"}
 
-    _progress("pencil_fft2d")
-    # 3. pencil FFT with all-to-all reshard
+
+def _bench_fft(pmt, rng, n_dev, scale):
+    import jax
     nf = (256 * scale, 256)
     F = pmt.MPIFFTND(nf, axes=(0, 1), dtype=np.complex64)
     xf = pmt.DistributedArray.to_dist(
@@ -93,12 +78,13 @@ def main(quick: bool = False):
     fn = jax.jit(lambda v: F.matvec(v).array)
     dt = _timeit(fn, xf, inner=5)
     flops = 5 * np.prod(nf) * np.log2(np.prod(nf))
-    results.append({"bench": "pencil_fft2d", "value":
-                    round(flops / dt / 1e9, 1), "unit": "GFLOP/s",
-                    "shape": f"{nf[0]}x{nf[1]}"})
+    return {"bench": "pencil_fft2d",
+            "value": round(flops / dt / 1e9, 1), "unit": "GFLOP/s",
+            "shape": f"{nf[0]}x{nf[1]}"}
 
-    _progress("fredholm1_batched")
-    # 4. Fredholm1 (MDC core): frequency-sharded batched matmul
+
+def _bench_fredholm(pmt, rng, n_dev, scale):
+    import jax
     nsl, nx_, ny_ = 8 * n_dev * scale, 64, 64
     G = rng.standard_normal((nsl, nx_, ny_)).astype(np.float32)
     Fr = pmt.MPIFredholm1(G, nz=4, dtype=np.float32)
@@ -107,12 +93,12 @@ def main(quick: bool = False):
         partition=pmt.Partition.BROADCAST)
     fn = jax.jit(lambda v: Fr.matvec(v).array)
     dt = _timeit(fn, xr, inner=5)
-    results.append({"bench": "fredholm1_batched", "value":
-                    round(2 * nsl * nx_ * ny_ * 4 / dt / 1e9, 1),
-                    "unit": "GFLOP/s", "shape": f"{nsl}x{nx_}x{ny_}"})
+    return {"bench": "fredholm1_batched",
+            "value": round(2 * nsl * nx_ * ny_ * 4 / dt / 1e9, 1),
+            "unit": "GFLOP/s", "shape": f"{nsl}x{nx_}x{ny_}"}
 
-    _progress("poststack_inversion")
-    # 5. poststack end-to-end (modelling + 10-iter CGLS)
+
+def _bench_poststack(pmt, rng, n_dev, scale):
     from pylops_mpi_tpu.models import ricker, poststack_inversion
     nt0, nxs = 256, 64 * n_dev * scale
     wav = ricker(np.arange(31) * 0.004, f0=15)[0].astype(np.float32)
@@ -120,13 +106,48 @@ def main(quick: bool = False):
     t0 = time.perf_counter()
     poststack_inversion(m, wav, niter=10, dtype=np.float32)
     dt = time.perf_counter() - t0
-    results.append({"bench": "poststack_inversion", "value":
-                    round(dt, 3), "unit": "s (incl. compile)",
-                    "shape": f"{nxs}x{nt0},10it"})
+    return {"bench": "poststack_inversion", "value": round(dt, 3),
+            "unit": "s (incl. compile)", "shape": f"{nxs}x{nt0},10it"}
 
-    for r in results:
+
+_BENCHES = [("first_derivative_halo", _bench_first_derivative),
+            ("summa_matmul", _bench_summa),
+            ("pencil_fft2d", _bench_fft),
+            ("fredholm1_batched", _bench_fredholm),
+            ("poststack_inversion", _bench_poststack)]
+
+
+def run_components(quick: bool = False):
+    """Run all component configs; never raises — failures are recorded
+    per-config as ``{"bench": name, "error": ...}``."""
+    import pylops_mpi_tpu as pmt
+
+    mesh = pmt.make_mesh()
+    pmt.set_default_mesh(mesh)
+    n_dev = int(mesh.devices.size)
+    scale = 1 if quick else 4
+    rng = np.random.default_rng(0)
+    results = []
+    for name, fn in _BENCHES:
+        _progress(name)
+        try:
+            results.append(fn(pmt, rng, n_dev, scale))
+        except Exception as e:
+            results.append({"bench": name, "error": repr(e)[:300]})
+    return results
+
+
+def main(quick: bool = False):
+    for r in run_components(quick=quick):
         print(json.dumps(r))
 
 
 if __name__ == "__main__":
+    if os.environ.get("PYLOPS_MPI_TPU_PLATFORM", "") == "cpu":
+        os.environ.setdefault(
+            "XLA_FLAGS",
+            (os.environ.get("XLA_FLAGS", "")
+             + " --xla_force_host_platform_device_count=8").strip())
+        import jax
+        jax.config.update("jax_platforms", "cpu")
     main(quick="--quick" in sys.argv)

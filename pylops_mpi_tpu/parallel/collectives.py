@@ -18,6 +18,7 @@ from __future__ import annotations
 from functools import partial
 from typing import Callable, List, Optional, Sequence
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -31,6 +32,7 @@ __all__ = [
     "ppermute_shift",
     "all_to_all_resharding",
     "ring_halo",
+    "cart_halo_extend",
 ]
 
 
@@ -143,6 +145,57 @@ def all_to_all_resharding(x: jax.Array, mesh: Mesh,
 
     return shard_map(kernel, mesh=mesh, in_specs=P(*in_spec),
                      out_specs=P(*out_spec))(x)
+
+
+def cart_halo_extend(block: jax.Array, axis_name: str,
+                     grid: Sequence[int], ax: int, hm: int, hp: int,
+                     valid_len) -> jax.Array:
+    """One axis of a Cartesian-grid halo exchange, for use *inside* a
+    ``shard_map`` kernel: extends ``block`` along array axis ``ax`` with
+    ``hm`` ghost rows from the minus-neighbour and ``hp`` from the
+    plus-neighbour of the flat mesh axis arranged as the row-major
+    ``grid``. Boundary shards keep zero ghosts (unpaired ``ppermute``
+    destinations are zero-filled), reproducing the reference's
+    zero-padded edges (``pylops_mpi/basicoperators/Halo.py:320-360``).
+
+    ``valid_len`` — the calling shard's count of logically-valid rows
+    along ``ax`` (traced per-device scalar for ragged ceil-splits): the
+    minus-ghost sent to the plus-neighbour is the *valid* tail
+    ``[valid_len-hm, valid_len)``, not the padded tail. Calling this per
+    axis in sequence relays corner values exactly like the reference's
+    sequential ``Sendrecv`` chain.
+
+    Sends only the boundary slabs — this is the neighbour exchange the
+    implicit partitioner cannot be trusted to recover from a gather
+    formulation, lowered to ``collective-permute`` on ICI.
+    """
+    g_ax = int(grid[ax])
+    if hm == 0 and hp == 0:
+        return block
+    if g_ax == 1:
+        padw = [(0, 0)] * block.ndim
+        padw[ax] = (hm, hp)
+        return jnp.pad(block, padw)
+    # flat-rank stride between ax-neighbours in the row-major grid
+    stride = int(np.prod([int(g) for g in grid[ax + 1:]]))
+    n = int(np.prod([int(g) for g in grid]))
+    coords = [np.unravel_index(r, tuple(int(g) for g in grid))[ax]
+              for r in range(n)]
+    parts = []
+    if hm:
+        # my valid tail -> plus-neighbour's front ghost
+        start = jnp.maximum(valid_len - hm, 0)
+        slab = lax.dynamic_slice_in_dim(block, start, hm, axis=ax)
+        perm = [(r, r + stride) for r in range(n) if coords[r] < g_ax - 1]
+        parts.append(lax.ppermute(slab, axis_name, perm))
+    parts.append(block)
+    if hp:
+        # my front rows -> minus-neighbour's back ghost (front rows are
+        # valid even for short ragged blocks)
+        slab = lax.slice_in_dim(block, 0, hp, axis=ax)
+        perm = [(r, r - stride) for r in range(n) if coords[r] > 0]
+        parts.append(lax.ppermute(slab, axis_name, perm))
+    return jnp.concatenate(parts, axis=ax)
 
 
 def ring_halo(x: jax.Array, mesh: Mesh, front: int = 0, back: int = 0):

@@ -10,6 +10,18 @@ sharded array. Step size defaults to ``1/λmax(OpᴴOp)`` via
 (``monitorres``, ref ``298-307``) and per-iteration cost
 ``½‖r‖² + ε‖x‖₁`` are preserved.
 
+Two execution paths, mirroring ``solvers/basic.py``:
+
+- **class API** (`ISTA`, `FISTA`): reference-parity ``setup/step/run``
+  with ``callback``/``monitorres`` hooks (host-synced scalars, as the
+  reference's mechanics demand, ref ``cls_sparsity.py:309-343``).
+- **fused path** (functional ``ista``/``fista`` default when no
+  callback/show/monitorres): the whole solve is one ``lax.while_loop``
+  under ``jit`` — matvec, rmatvec, threshold, momentum and the norm
+  ``psum``s compile into a single XLA program; cost history lives in a
+  fixed-length on-device buffer, and no scalar crosses the host
+  boundary per iteration (SURVEY §7: THE idiomatic-redesign win).
+
 Threshold formulas match pylops' ``_softthreshold`` / ``_hardthreshold``
 (cut at ``√(2·thresh)``) / ``_halfthreshold`` (cut at
 ``(54^⅓/4)·thresh^⅔``).
@@ -18,12 +30,14 @@ Threshold formulas match pylops' ``_softthreshold`` / ``_hardthreshold``
 from __future__ import annotations
 
 import time
+from functools import partial
 from math import sqrt
 from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..distributedarray import DistributedArray
 from ..stacked import StackedDistributedArray
@@ -42,7 +56,7 @@ def _softthreshold(x: jax.Array, thresh) -> jax.Array:
 
 
 def _hardthreshold(x: jax.Array, thresh) -> jax.Array:
-    return jnp.where(jnp.abs(x) <= np.sqrt(2 * thresh), 0, x)
+    return jnp.where(jnp.abs(x) <= jnp.sqrt(2 * thresh), 0, x)
 
 
 def _halfthreshold(x: jax.Array, thresh) -> jax.Array:
@@ -231,13 +245,107 @@ class FISTA(ISTA):
         return x, xupdate
 
 
+# --------------------------------------------------------- fused (on-device)
+def _ista_fused(Op, y: Vector, x0: Vector, alpha, eps, tol, decay,
+                *, niter: int, threshf: Callable, SOp=None,
+                momentum: bool = False):
+    """Whole ISTA/FISTA solve as one ``lax.while_loop``. The eager class
+    API pulls 3-4 host floats per iteration (xupdate, costdata, costreg,
+    optionally normres); here every scalar stays on device and the
+    threshold/momentum arithmetic fuses into the matvec program."""
+    thresh = eps * alpha * 0.5
+    decay_arr = jnp.asarray(decay)
+    nd = decay_arr.shape[0]
+
+    def threshold(v, iiter):
+        tv = decay_arr[jnp.minimum(iiter, nd - 1)] * thresh
+        return _apply_thresh(v, threshf, tv)
+
+    def body(state):
+        x, z, t, iiter, cost, _ = state
+        xin = z if momentum else x
+        res = y - Op.matvec(xin)
+        x_unthresh = xin + Op.rmatvec(res) * alpha
+        if SOp is not None:
+            x_unthresh = SOp.rmatvec(x_unthresh)
+        xnew = threshold(x_unthresh, iiter)
+        if SOp is not None:
+            xnew = SOp.matvec(xnew)
+        if momentum:
+            # Nesterov sequence (ref cls_sparsity.py:645-649)
+            tnew = (1.0 + jnp.sqrt(1.0 + 4.0 * t * t)) / 2.0
+            znew = xnew + (xnew - x) * ((t - 1.0) / tnew)
+            costdata = 0.5 * jnp.max(jnp.asarray(
+                (y - Op.matvec(xnew)).norm())) ** 2
+        else:
+            tnew, znew = t, xnew
+            costdata = 0.5 * jnp.max(jnp.asarray(res.norm())) ** 2
+        costreg = eps * jnp.max(jnp.asarray(xnew.norm(1)))
+        xupdate = jnp.max(jnp.asarray((xnew - x).norm()))
+        cost = lax.dynamic_update_index_in_dim(
+            cost, (costdata + costreg).astype(cost.dtype), iiter, 0)
+        return (xnew, znew, tnew, iiter + 1, cost, xupdate)
+
+    def cond(state):
+        return (state[3] < niter) & (state[5] > tol)
+
+    x = x0.copy()
+    z = x0.copy()
+    t0 = jnp.asarray(1.0)
+    cost0 = jnp.zeros((niter,), dtype=t0.dtype)
+    state = (x, z, t0, jnp.asarray(0), cost0, jnp.asarray(jnp.inf))
+    x, z, t, iiter, cost, xupdate = lax.while_loop(cond, body, state)
+    return x, iiter, cost
+
+
+def _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha, eigsdict, tol,
+                        threshkind, decay, momentum):
+    from .basic import _get_fused, _vkey
+
+    if threshkind not in _THRESHF:
+        raise NotImplementedError("threshkind should be hard, soft or half")
+    if x0 is None:
+        raise ValueError("x0 required")
+    if alpha is None:
+        Op1 = Op.H @ Op
+        b0 = x0.zeros_like() if isinstance(x0, DistributedArray) else x0.copy()
+        maxeig = np.abs(power_iteration(Op1, b_k=b0, dtype=Op1.dtype,
+                                        **(eigsdict or {}))[0])
+        alpha = float(1.0 / maxeig)
+    decay = np.ones(niter) if decay is None else np.asarray(decay)
+    key = (id(Op), "fista" if momentum else "ista", niter, threshkind,
+           id(SOp) if SOp is not None else None, len(decay),
+           _vkey(y), _vkey(x0))
+    fn = _get_fused(Op, key,
+                    partial(_ista_fused, Op, niter=niter,
+                            threshf=_THRESHF[threshkind], SOp=SOp,
+                            momentum=momentum))
+    x, iiter, cost = fn(y=y, x0=x0, alpha=alpha, eps=eps, tol=tol,
+                        decay=jnp.asarray(decay))
+    iiter = int(iiter)
+    return x, iiter, np.asarray(cost)[:iiter]
+
+
 def ista(Op, y: Vector, x0: Optional[Vector] = None,
          niter: int = 10, SOp=None, eps: float = 0.1,
          alpha: Optional[float] = None, eigsdict=None, tol: float = 1e-10,
          threshkind: str = "soft", perc=None, decay=None,
          monitorres: bool = False, show: bool = False, itershow=(10, 10, 10),
-         callback: Optional[Callable] = None):
-    """Functional ISTA (ref ``optimization/sparsity.py:11-133``)."""
+         callback: Optional[Callable] = None, fused: Optional[bool] = None):
+    """Functional ISTA (ref ``optimization/sparsity.py:11-133``). With no
+    callback/show/monitorres, runs the fused on-device loop."""
+    use_fused = fused if fused is not None else \
+        (callback is None and not show and not monitorres and perc is None)
+    if use_fused:
+        if callback is not None or show or monitorres:
+            raise ValueError("fused=True cannot honor callback/show/"
+                             "monitorres; use fused=False for hooks")
+        if perc is not None:
+            raise NotImplementedError(
+                "percentile thresholding is not implemented")
+        return _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha,
+                                   eigsdict, tol, threshkind, decay,
+                                   momentum=False)
     solver = ISTA(Op)
     if callback is not None:
         solver.callback = callback
@@ -252,8 +360,21 @@ def fista(Op, y: Vector, x0: Optional[Vector] = None,
           alpha: Optional[float] = None, eigsdict=None, tol: float = 1e-10,
           threshkind: str = "soft", perc=None, decay=None,
           monitorres: bool = False, show: bool = False, itershow=(10, 10, 10),
-          callback: Optional[Callable] = None):
-    """Functional FISTA (ref ``optimization/sparsity.py:136-257``)."""
+          callback: Optional[Callable] = None, fused: Optional[bool] = None):
+    """Functional FISTA (ref ``optimization/sparsity.py:136-257``). With
+    no callback/show/monitorres, runs the fused on-device loop."""
+    use_fused = fused if fused is not None else \
+        (callback is None and not show and not monitorres and perc is None)
+    if use_fused:
+        if callback is not None or show or monitorres:
+            raise ValueError("fused=True cannot honor callback/show/"
+                             "monitorres; use fused=False for hooks")
+        if perc is not None:
+            raise NotImplementedError(
+                "percentile thresholding is not implemented")
+        return _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha,
+                                   eigsdict, tol, threshkind, decay,
+                                   momentum=True)
     solver = FISTA(Op)
     if callback is not None:
         solver.callback = callback

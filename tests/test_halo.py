@@ -105,6 +105,33 @@ def test_halo_sandwich_conv(rng):
     np.testing.assert_allclose(got, expected, rtol=1e-12)
 
 
+def test_halo_hlo_is_neighbor_exchange(rng):
+    """The lowered program moves boundary slabs with collective-permute
+    and never all-gathers the full array (the round-1 implementation's
+    failure mode: global gather + re-slice)."""
+    import jax
+
+    n = 32
+    Hop = MPIHalo(dims=n, halo=1, dtype=np.float64)
+    dx = DistributedArray.to_dist(rng.standard_normal(n))
+    fn = jax.jit(lambda d: Hop.matvec(d)._arr)
+    txt = fn.lower(dx).compile().as_text().lower()
+    assert "collective-permute" in txt or "collective_permute" in txt
+    assert "all-gather" not in txt and "all_gather" not in txt
+
+    # 2-D grid matvec+adjoint roundtrip: still permute-only
+    dims, grid = (8, 8), (2, 4)
+    x2 = rng.standard_normal(dims)
+    flat, sizes = _block_flat(x2, grid)
+    Hop2 = MPIHalo(dims=dims, halo=1, proc_grid_shape=grid,
+                   dtype=np.float64)
+    dx2 = DistributedArray.to_dist(flat, local_shapes=sizes)
+    fn2 = jax.jit(lambda d: Hop2.rmatvec(Hop2.matvec(d))._arr)
+    txt2 = fn2.lower(dx2).compile().as_text().lower()
+    assert "collective-permute" in txt2 or "collective_permute" in txt2
+    assert "all-gather" not in txt2 and "all_gather" not in txt2
+
+
 def test_halo_validates_width():
     with pytest.raises(ValueError, match="halo width exceeds"):
         MPIHalo(dims=16, halo=3, dtype=np.float64)  # blocks of 2 < halo 3

@@ -119,3 +119,57 @@ def test_ista_callback_and_decay(rng):
                            decay=np.linspace(1, 0.1, 5), tol=0,
                            callback=lambda xx: seen.append(1))
     assert len(seen) == niters == 5
+
+
+@pytest.mark.parametrize("solver", [ista, fista])
+@pytest.mark.parametrize("threshkind", ["soft", "hard", "half"])
+def test_fused_matches_eager(rng, solver, threshkind):
+    """The single-XLA-program while_loop path reproduces the eager
+    class-API iterates (same cost history, same model)."""
+    mats = [rng.standard_normal((10, 8)) / 4 + np.eye(10, 8) for _ in range(8)]
+    Op = MPIBlockDiag([MatrixMult(m, dtype=np.float64) for m in mats])
+    xs = np.zeros(64)
+    xs[rng.choice(64, 6, replace=False)] = rng.standard_normal(6) * 2
+    y = DistributedArray.to_dist(
+        dense_blockdiag(mats) @ xs)
+    x0 = DistributedArray(global_shape=64, dtype=np.float64)
+    x0[:] = 0.0
+    decay = np.linspace(1.0, 0.2, 15)
+    kw = dict(niter=15, eps=0.02, threshkind=threshkind, decay=decay,
+              tol=0.0)
+    xf, itf, costf = solver(Op, y, x0, fused=True, **kw)
+    xe, ite, coste = solver(Op, y, x0, fused=False, **kw)
+    assert itf == ite
+    np.testing.assert_allclose(xf.asarray(), xe.asarray(), rtol=1e-10,
+                               atol=1e-12)
+    np.testing.assert_allclose(costf, coste, rtol=1e-8)
+
+
+def test_fused_tol_early_stop(rng):
+    """xupdate <= tol stops the fused loop at the same iteration as the
+    eager run loop."""
+    mats = [np.eye(8) for _ in range(8)]
+    Op = MPIBlockDiag([MatrixMult(m, dtype=np.float64) for m in mats])
+    y = DistributedArray.to_dist(rng.standard_normal(64))
+    x0 = DistributedArray(global_shape=64, dtype=np.float64)
+    x0[:] = 0.0
+    kw = dict(niter=50, eps=0.01, alpha=1.0, tol=1e-6)
+    xf, itf, _ = ista(Op, y, x0, fused=True, **kw)
+    xe, ite, _ = ista(Op, y, x0, fused=False, **kw)
+    assert itf == ite
+    assert itf < 50
+    np.testing.assert_allclose(xf.asarray(), xe.asarray(), rtol=1e-10)
+
+
+def test_power_iteration_fused_matches_eager(rng):
+    mats = []
+    for _ in range(8):
+        a = rng.standard_normal((6, 6))
+        mats.append(a @ a.T)
+    Op = MPIBlockDiag([MatrixMult(m, dtype=np.float64) for m in mats])
+    b0 = DistributedArray(global_shape=48, dtype=np.float64)
+    ef, bf, itf = power_iteration(Op, b0, niter=100, tol=1e-9, fused=True)
+    ee, be, ite = power_iteration(Op, b0, niter=100, tol=1e-9, fused=False)
+    assert itf == ite
+    np.testing.assert_allclose(ef, ee, rtol=1e-10)
+    np.testing.assert_allclose(bf.asarray(), be.asarray(), rtol=1e-8)
