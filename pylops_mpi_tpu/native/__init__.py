@@ -61,7 +61,12 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
         tmp = so + f".tmp{os.getpid()}"
         cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
                _SRC, "-o", tmp]
-        subprocess.run(cmd, check=True, capture_output=True)
+        try:
+            subprocess.run(cmd, check=True, capture_output=True)
+        except subprocess.CalledProcessError as e:
+            stderr = (e.stderr or b"").decode("utf-8", "replace")[-800:]
+            raise RuntimeError(
+                f"g++ build failed (rc={e.returncode}): {stderr}") from e
         os.replace(tmp, so)
     lib = ctypes.CDLL(so)
     i64, i32, cp = ctypes.c_int64, ctypes.c_int32, ctypes.c_char_p
@@ -134,6 +139,13 @@ def pack_padded(x: np.ndarray, axis: int, sizes: Sequence[int],
     axis = axis % x.ndim
     sizes = np.ascontiguousarray(sizes, dtype=np.int64)
     P = len(sizes)
+    if np.any(sizes < 0):
+        raise ValueError("sizes must be non-negative")
+    if int(sizes.sum()) != x.shape[axis]:
+        raise ValueError(f"sum(sizes)={int(sizes.sum())} != "
+                         f"x.shape[{axis}]={x.shape[axis]}")
+    if P and int(sizes.max()) > int(s_phys):
+        raise ValueError(f"max(sizes)={int(sizes.max())} > s_phys={s_phys}")
     shp = list(x.shape)
     shp[axis] = P * int(s_phys)
     lib = _get_lib()
@@ -161,6 +173,13 @@ def unpack_padded(x: np.ndarray, axis: int, sizes: Sequence[int],
     axis = axis % x.ndim
     sizes = np.ascontiguousarray(sizes, dtype=np.int64)
     P = len(sizes)
+    if np.any(sizes < 0):
+        raise ValueError("sizes must be non-negative")
+    if x.shape[axis] != P * int(s_phys):
+        raise ValueError(f"x.shape[{axis}]={x.shape[axis]} != "
+                         f"len(sizes)*s_phys={P * int(s_phys)}")
+    if P and int(sizes.max()) > int(s_phys):
+        raise ValueError(f"max(sizes)={int(sizes.max())} > s_phys={s_phys}")
     shp = list(x.shape)
     shp[axis] = int(sizes.sum())
     lib = _get_lib()

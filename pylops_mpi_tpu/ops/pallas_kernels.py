@@ -126,9 +126,14 @@ def _pick_tile(m: int, n: int, itemsize: int) -> int:
 
 def normal_matvec_supported(A: jax.Array) -> bool:
     """Pallas path requires real floating blocks (complex dots fall back
-    to the generic two-sweep path)."""
-    return (_HAS_PALLAS and pallas_available() and A.ndim == 3
-            and not jnp.iscomplexobj(A))
+    to the generic two-sweep path) narrow enough that a single row tile
+    fits the VMEM budget — otherwise even tm=1 would fail at Mosaic
+    compile time and the generic two-sweep path must be used."""
+    if not (_HAS_PALLAS and pallas_available() and A.ndim == 3
+            and not jnp.iscomplexobj(A)):
+        return False
+    n = A.shape[2]
+    return n * max(A.dtype.itemsize, 4) <= _VMEM_TILE_BYTES
 
 
 def _normal_kernel(a_ref, x_ref, u_ref, q_ref):

@@ -32,10 +32,50 @@ def _enabled() -> bool:
     return int(flag) == 1
 
 
-# Stack of active mark functions (nested benchmark support,
-# ref benchmark.py:27-29)
-_mark_func_stack: List[Callable] = []
-_markers: List = []
+# Active span stack for nested @benchmark calls. Unlike the reference's
+# flat (label, time, level) marker list decoded by a post-hoc stack walk
+# (ref benchmark.py:27-67), regions here are first-class span objects
+# built live: a decorated call opens a _Span, mark() timestamps segment
+# boundaries inside the innermost open span, and nested decorated calls
+# attach themselves as children. Rendering is then a trivial tree walk.
+_span_stack: List["_Span"] = []
+
+
+class _Span:
+    """One timed region: wall-clock extent + ordered segment marks +
+    nested child spans (kept in chronological order)."""
+
+    __slots__ = ("label", "t0", "t1", "marks", "children")
+
+    def __init__(self, label: str):
+        self.label = label
+        self.t0 = 0.0
+        self.t1 = 0.0
+        self.marks: List = []      # (label, timestamp)
+        self.children: List["_Span"] = []
+
+    @property
+    def total(self) -> float:
+        return self.t1 - self.t0
+
+    def segments(self):
+        """Durations between consecutive marks; the first segment runs
+        from span start to the first mark, the last from the final mark
+        to span end."""
+        edges = [("start", self.t0)] + self.marks + [("end", self.t1)]
+        for (a, ta), (b, tb) in zip(edges, edges[1:]):
+            yield a, b, tb - ta
+
+    def render(self, lines: List[str], depth: int = 0) -> List[str]:
+        pad = "  " * depth
+        lines.append(f"{pad}[{self.label}] total {self.total:.6f} s\n")
+        if self.marks:
+            for a, b, dt in self.segments():
+                pct = 100.0 * dt / self.total if self.total > 0 else 0.0
+                lines.append(f"{pad}  {a} => {b}: {dt:.6f} s ({pct:.1f}%)\n")
+        for child in self.children:
+            child.render(lines, depth + 1)
+        return lines
 
 
 def _sync(values=()) -> None:
@@ -50,47 +90,24 @@ def _sync(values=()) -> None:
 
 
 def mark(label: str, *values) -> None:
-    """Region marker (ref ``benchmark.py:76-90``): ends the previous
-    region and starts a new one. Optional ``values`` are block-waited to
-    attribute asynchronous device work to the right region."""
+    """Segment boundary inside a ``@benchmark``-ed function (ref
+    ``benchmark.py:76-90``): closes the running segment and opens the
+    next. Optional ``values`` are block-waited first so asynchronous
+    device work is attributed to the segment that launched it."""
     if not _enabled():
         return
-    if not _mark_func_stack:
+    if not _span_stack:
         raise RuntimeError("mark() called outside of a benchmarked region")
     _sync(values)
-    _mark_func_stack[-1](label)
-
-
-def _parse_output_tree(markers) -> List[str]:
-    """ref ``benchmark.py:33-67``"""
-    output = []
-    stack: List = []
-    i = 0
-    while i < len(markers):
-        label, t, level = markers[i]
-        if label.startswith("[decorator]"):
-            indent = "\t" * (level - 1)
-            output.append(f"{indent}{label}: total runtime: {t:6f} s\n")
-        else:
-            if stack:
-                prev_label, prev_time, prev_level = stack[-1]
-                if prev_level == level:
-                    indent = "\t" * level
-                    output.append(
-                        f"{indent}{prev_label}-->{label}: {t - prev_time:6f} s\n")
-                    stack.pop()
-            if i + 1 <= len(markers) - 1:
-                _, _, next_level = markers[i + 1]
-                if next_level >= level:
-                    stack.append(markers[i])
-        i += 1
-    return output
+    _span_stack[-1].marks.append((label, time.perf_counter()))
 
 
 def benchmark(func: Optional[Callable] = None, description: str = "",
               logger: Optional[logging.Logger] = None):
     """Decorator measuring start-to-end runtime with nested ``mark``
-    support (ref ``benchmark.py:92-173``)."""
+    support (ref ``benchmark.py:92-173``; output format redesigned —
+    span tree with per-segment percentages instead of the reference's
+    arrow chains)."""
 
     def noop_decorator(f):
         @functools.wraps(f)
@@ -101,24 +118,20 @@ def benchmark(func: Optional[Callable] = None, description: str = "",
     def actual_decorator(f):
         @functools.wraps(f)
         def wrapped(*args, **kwargs):
-            global _markers
-            level = len(_mark_func_stack) + 1
-
-            def local_mark(label):
-                _markers.append((label, time.perf_counter(), level))
-
-            _mark_func_stack.append(local_mark)
-            desc = description or f.__name__
+            span = _Span(description or f.__name__)
+            if _span_stack:
+                _span_stack[-1].children.append(span)
+            _span_stack.append(span)
             _sync()
-            t0 = time.perf_counter()
-            out = f(*args, **kwargs)
-            _sync((out,))
-            t1 = time.perf_counter()
-            _mark_func_stack.pop()
-            _markers.append((f"[decorator] {desc}", t1 - t0, level))
-            if not _mark_func_stack:
-                text = "".join(_parse_output_tree(_markers))
-                _markers = []
+            span.t0 = time.perf_counter()
+            try:
+                out = f(*args, **kwargs)
+                _sync((out,))
+            finally:
+                span.t1 = time.perf_counter()
+                _span_stack.pop()
+            if not _span_stack:
+                text = "".join(span.render([]))
                 if logger is not None:
                     logger.info("\n" + text)
                 else:

@@ -152,3 +152,25 @@ def test_fallback_matches_native(rng, monkeypatch):
     np.testing.assert_array_equal(ref_packed, fb_packed)
     fb_back = native.unpack_padded(fb_packed, 1, sizes, s_phys)
     np.testing.assert_array_equal(fb_back, x)
+
+
+def test_pack_padded_rejects_bad_sizes(rng):
+    """Mismatched sizes must raise a Python error, never reach the C++
+    memcpy loops (advisor round-1 finding)."""
+    x = rng.standard_normal((4, 10))
+    good = native.local_split_native(10, 3)
+    s_phys = int(good.max())
+    with pytest.raises(ValueError, match="sum"):
+        native.pack_padded(x, 1, [4, 4, 4], s_phys)  # sum=12 != 10
+    with pytest.raises(ValueError, match="s_phys"):
+        native.pack_padded(x, 1, [2, 3, 5], 4)  # a size exceeds s_phys
+    with pytest.raises(ValueError, match="non-negative"):
+        native.pack_padded(x, 1, [4, 4, 4, -2], s_phys)
+
+
+def test_unpack_padded_rejects_bad_shape(rng):
+    x = rng.standard_normal((4, 12))
+    with pytest.raises(ValueError, match="len\\(sizes\\)\\*s_phys"):
+        native.unpack_padded(x, 1, [4, 3, 3], 5)  # 3*5 != 12
+    with pytest.raises(ValueError, match="s_phys"):
+        native.unpack_padded(x, 1, [4, 5, 3], 4)  # size 5 > s_phys 4

@@ -23,8 +23,10 @@ def test_benchmark_decorator(capsys):
 
     assert work() == 499500
     out = capsys.readouterr().out
-    assert "[decorator] work" in out
-    assert "phase-a-->phase-b" in out
+    assert "[work] total" in out
+    assert "phase-a => phase-b" in out
+    assert "start => phase-a" in out
+    assert "phase-b => end" in out
 
 
 def test_benchmark_nested(capsys):
