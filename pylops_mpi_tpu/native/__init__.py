@@ -117,6 +117,17 @@ def _ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.c_void_p)
 
 
+def _check_sizes(sizes: np.ndarray, s_phys: int) -> None:
+    """Shared validation so a bad public-API call raises here instead of
+    corrupting memory in the C++ memcpy loops (advisor round-1 note)."""
+    if sizes.ndim != 1:
+        raise ValueError("sizes must be 1-D")
+    if np.any(sizes < 0):
+        raise ValueError("sizes must be non-negative")
+    if len(sizes) and int(sizes.max()) > int(s_phys):
+        raise ValueError(f"max(sizes)={int(sizes.max())} > s_phys={s_phys}")
+
+
 # ------------------------------------------------------------ public API
 def local_split_native(n: int, nshards: int) -> np.ndarray:
     """Balanced axis split (ref ``DistributedArray.py:62-71``)."""
@@ -139,13 +150,10 @@ def pack_padded(x: np.ndarray, axis: int, sizes: Sequence[int],
     axis = axis % x.ndim
     sizes = np.ascontiguousarray(sizes, dtype=np.int64)
     P = len(sizes)
-    if np.any(sizes < 0):
-        raise ValueError("sizes must be non-negative")
+    _check_sizes(sizes, s_phys)
     if int(sizes.sum()) != x.shape[axis]:
         raise ValueError(f"sum(sizes)={int(sizes.sum())} != "
                          f"x.shape[{axis}]={x.shape[axis]}")
-    if P and int(sizes.max()) > int(s_phys):
-        raise ValueError(f"max(sizes)={int(sizes.max())} > s_phys={s_phys}")
     shp = list(x.shape)
     shp[axis] = P * int(s_phys)
     lib = _get_lib()
@@ -173,13 +181,10 @@ def unpack_padded(x: np.ndarray, axis: int, sizes: Sequence[int],
     axis = axis % x.ndim
     sizes = np.ascontiguousarray(sizes, dtype=np.int64)
     P = len(sizes)
-    if np.any(sizes < 0):
-        raise ValueError("sizes must be non-negative")
+    _check_sizes(sizes, s_phys)
     if x.shape[axis] != P * int(s_phys):
         raise ValueError(f"x.shape[{axis}]={x.shape[axis]} != "
                          f"len(sizes)*s_phys={P * int(s_phys)}")
-    if P and int(sizes.max()) > int(s_phys):
-        raise ValueError(f"max(sizes)={int(sizes.max())} > s_phys={s_phys}")
     shp = list(x.shape)
     shp[axis] = int(sizes.sum())
     lib = _get_lib()

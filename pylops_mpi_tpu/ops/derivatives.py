@@ -110,7 +110,11 @@ class MPIFirstDerivative(_StencilOperator):
 
 class MPISecondDerivative(_StencilOperator):
     """Second derivative along axis 0
-    (ref ``basicoperators/SecondDerivative.py:13-256``)."""
+    (ref ``basicoperators/SecondDerivative.py:13-256``): forward /
+    backward / centered 3-point stencils; ``edge`` adds the one-sided
+    boundary rows for centered (the reference special-cases rank 0 and
+    rank P-1, ref ``SecondDerivative.py:215-240``; here the boundary is
+    the edge of the global array)."""
 
     def __init__(self, dims, sampling: float = 1.0, kind: str = "centered",
                  edge: bool = False, mesh=None, dtype=np.float64):
@@ -119,7 +123,7 @@ class MPISecondDerivative(_StencilOperator):
         self.kind = kind
         self.edge = edge
         self._op = _LocalSecond(self.dims_nd, axis=0, sampling=sampling,
-                                dtype=dtype)
+                                kind=kind, edge=edge, dtype=dtype)
 
     def _local_op(self):
         return self._op
@@ -140,7 +144,9 @@ class MPILaplacian(_StencilOperator):
         if not (len(axes) == len(weights) == len(sampling)):
             raise ValueError("axes, weights, and sampling have different size")
         self.axes, self.weights, self.sampling = axes, tuple(weights), tuple(sampling)
-        self._ops = [_LocalSecond(self.dims_nd, axis=ax, sampling=s, dtype=dtype)
+        self.kind, self.edge = kind, edge
+        self._ops = [_LocalSecond(self.dims_nd, axis=ax, sampling=s,
+                                  kind=kind, edge=edge, dtype=dtype)
                      for ax, s in zip(axes, sampling)]
 
     def _apply(self, x: DistributedArray, forward: bool) -> DistributedArray:

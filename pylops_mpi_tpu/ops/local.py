@@ -413,12 +413,24 @@ class FirstDerivative(LocalOperator):
 
 
 class SecondDerivative(LocalOperator):
-    """3-point second derivative (pylops ``edge=False`` semantics);
-    scatter-free for partitioner safety (see FirstDerivative note)."""
+    """3-point second derivative, all three pylops stencil kinds
+    (ref ``basicoperators/SecondDerivative.py:78-108`` registers
+    forward/centered/backward; ``edge`` affects centered only, as in
+    serial pylops). Scatter-free for partitioner safety (see
+    FirstDerivative note).
+
+    Global-view stencils (core ``d[i] = x[i] - 2 x[i+1] + x[i+2]``):
+    forward places ``d[i]`` at row ``i`` (last two rows zero), backward
+    at row ``i+2`` (first two rows zero), centered at row ``i+1`` with
+    optional one-sided ``edge`` rows at 0 and n-1."""
 
     def __init__(self, dims, axis: int = 0, sampling: float = 1.0,
-                 dtype=None):
+                 kind: str = "centered", edge: bool = False, dtype=None):
         self.dims_nd, self.axis, self.sampling = _deriv_setup(dims, axis, sampling)
+        if kind not in ("forward", "backward", "centered"):
+            raise NotImplementedError(
+                "'kind' must be 'forward', 'centered' or 'backward'")
+        self.kind, self.edge = kind, edge
         super().__init__(self.dims_nd, self.dims_nd, dtype=dtype)
 
     @staticmethod
@@ -426,16 +438,34 @@ class SecondDerivative(LocalOperator):
         padw = [(before, after)] + [(0, 0)] * (v.ndim - 1)
         return jnp.pad(v, padw)
 
+    # row offset of the stencil core within the output, per kind
+    _CORE_OFFSET = {"forward": (0, 2), "centered": (1, 1), "backward": (2, 0)}
+
     def _matvec(self, x):
         v = jnp.moveaxis(x.reshape(self.dims_nd), self.axis, 0)
         s2 = self.sampling ** 2
-        y = self._pad0((v[2:] - 2 * v[1:-1] + v[:-2]) / s2, 1, 1)
+        p = self._pad0
+        before, after = self._CORE_OFFSET[self.kind]
+        y = p((v[:-2] - 2 * v[1:-1] + v[2:]) / s2, before, after)
+        if self.kind == "centered" and self.edge:
+            n = v.shape[0]
+            y = y + p(((v[0] - 2 * v[1] + v[2]) / s2)[None], 0, n - 1)
+            y = y + p(((v[-3] - 2 * v[-2] + v[-1]) / s2)[None], n - 1, 0)
         return jnp.moveaxis(y, 0, self.axis).ravel()
 
     def _rmatvec(self, x):
         v = jnp.moveaxis(x.reshape(self.dims_nd), self.axis, 0)
-        c = v[1:-1] / self.sampling ** 2
-        y = self._pad0(c, 0, 2) - 2 * self._pad0(c, 1, 1) + self._pad0(c, 2, 0)
+        s2 = self.sampling ** 2
+        p = self._pad0
+        n = v.shape[0]
+        before, after = self._CORE_OFFSET[self.kind]
+        # adjoint spreads each output row back over its 3 input columns:
+        # c holds the rows carrying the core, shifted to columns 0/1/2
+        c = v[before:n - after] / s2
+        y = p(c, 0, 2) - 2 * p(c, 1, 1) + p(c, 2, 0)
+        if self.kind == "centered" and self.edge:
+            y = y + p(jnp.stack([v[0], -2 * v[0], v[0]]) / s2, 0, n - 3)
+            y = y + p(jnp.stack([v[-1], -2 * v[-1], v[-1]]) / s2, n - 3, 0)
         return jnp.moveaxis(y, 0, self.axis).ravel()
 
 

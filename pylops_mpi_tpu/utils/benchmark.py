@@ -119,10 +119,10 @@ def benchmark(func: Optional[Callable] = None, description: str = "",
         @functools.wraps(f)
         def wrapped(*args, **kwargs):
             span = _Span(description or f.__name__)
+            _sync()
             if _span_stack:
                 _span_stack[-1].children.append(span)
             _span_stack.append(span)
-            _sync()
             span.t0 = time.perf_counter()
             try:
                 out = f(*args, **kwargs)
