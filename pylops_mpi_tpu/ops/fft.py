@@ -29,6 +29,9 @@ from jax import lax
 from ..distributedarray import DistributedArray, Partition
 from ..linearoperator import MPILinearOperator
 from ..parallel.mesh import axis_sharding
+from ..parallel.collectives import all_to_all_resharding
+from ..parallel.partition import (local_split, pad_index_map,
+                                  unpad_index_map)
 
 __all__ = ["MPIFFTND", "MPIFFT2D"]
 
@@ -102,6 +105,37 @@ class _MPIBaseFFTND(MPILinearOperator):
         else:
             self._out_axis = self._in_axis
         self._scale = float(np.prod(self.nffts))
+        # Row-aligned pencil layouts for the in_axis==0 fast path: when
+        # the flat input/output vectors carry these local shapes, the
+        # flat <-> cube conversions are pure per-shard reshapes (zero
+        # comm) and all data movement is the two explicit all-to-all
+        # pencil transposes — ragged sizes included (pad-to-multiple
+        # while sharded, crop once local; replaces round 1's full
+        # replication fallback, ref mpi4py-fft FFTND.py:188-211).
+        P = int(self.mesh.devices.size)
+        self._rows_m = tuple(s[0] for s in local_split(
+            self.dims_nd, P, Partition.SCATTER, 0))
+        self._rows_d = tuple(s[0] for s in local_split(
+            self.dimsd_nd, P, Partition.SCATTER, 0))
+        inner_m = int(np.prod(self.dims_nd[1:])) if ndim > 1 else 1
+        inner_d = int(np.prod(self.dimsd_nd[1:])) if ndim > 1 else 1
+        self._mlocals = tuple((r * inner_m,) for r in self._rows_m)
+        self._dlocals = tuple((r * inner_d,) for r in self._rows_d)
+
+    @property
+    def model_local_shapes(self):
+        """Flat per-shard shapes the operator's model side prefers: a
+        vector carrying these enters the pencil schedule with a pure
+        reshape (zero communication). Outputs of ``rmatvec`` carry them,
+        so chained/iterated applications stay aligned; pass to
+        ``DistributedArray.to_dist(..., local_shapes=...)`` for inputs."""
+        return self._mlocals
+
+    @property
+    def data_local_shapes(self):
+        """Flat per-shard shapes of the data side (see
+        :attr:`model_local_shapes`); ``matvec`` outputs carry them."""
+        return self._dlocals
 
     # ------------------------------------------------------------- helpers
     def _shift_axes(self, flags) -> Tuple[int, ...]:
@@ -119,17 +153,47 @@ class _MPIBaseFFTND(MPILinearOperator):
         shape[ax] = y.shape[ax]
         return y * vec.reshape(shape)
 
-    def _constrain(self, g: jax.Array, axis: int) -> jax.Array:
-        """Reshard so ``axis`` is the distributed one; if its size does
-        not tile the mesh, fall back to replication (correctness first —
-        the FFT custom-call must never see its own axis sharded)."""
-        if g.shape[axis] % int(self.mesh.devices.size) == 0:
+    def _reshard(self, g: jax.Array, new_axis: int,
+                 cur_axis: Optional[int] = None,
+                 cur_pad: int = 0) -> Tuple[jax.Array, int]:
+        """Move the distributed dimension to ``new_axis`` (the pencil
+        transpose — XLA lowers the sharding change to an all-to-all over
+        ICI). Axes that do not tile the mesh are zero-padded to the next
+        multiple of the device count while sharded and cropped as soon as
+        they become local again (the pad-and-mask idiom of
+        ``DistributedArray``; replaces round 1's full-replication
+        fallback, ref mpi4py-fft's ragged pencils ``FFTND.py:188-211``).
+        Returns ``(g, new_pad)`` where ``new_pad`` is the number of
+        trailing zero rows now carried by ``new_axis``."""
+        P = int(self.mesh.devices.size)
+        new_pad = (-g.shape[new_axis]) % P
+        if new_pad:
+            padw = [(0, 0)] * g.ndim
+            padw[new_axis] = (0, new_pad)
+            g = jnp.pad(g, padw)
+        if (cur_axis is not None and cur_axis != new_axis and P > 1
+                and len(self.mesh.axis_names) == 1):
+            # explicit pencil transpose: one lax.all_to_all of the padded
+            # tiles — pinned by hand because GSPMD lowers the equivalent
+            # pad+constraint+crop sequence to a full-array all-gather
+            g = all_to_all_resharding(g, self.mesh, cur_axis, new_axis)
+        else:
             try:
-                return lax.with_sharding_constraint(
-                    g, axis_sharding(self.mesh, g.ndim, axis))
-            except Exception:
+                g = lax.with_sharding_constraint(
+                    g, axis_sharding(self.mesh, g.ndim, new_axis))
+            except Exception:  # outside jit on an abstract mesh
                 pass
-        return self._constrain_replicated(g)
+        if cur_axis is not None and cur_axis != new_axis:
+            g = self._crop(g, cur_axis, cur_pad)
+        return g, new_pad
+
+    @staticmethod
+    def _crop(g: jax.Array, axis: int, pad: int) -> jax.Array:
+        if not pad:
+            return g
+        idx = [slice(None)] * g.ndim
+        idx[axis] = slice(0, g.shape[axis] - pad)
+        return g[tuple(idx)]
 
     def _constrain_replicated(self, g: jax.Array) -> jax.Array:
         from ..parallel.mesh import replicated_sharding
@@ -139,11 +203,239 @@ class _MPIBaseFFTND(MPILinearOperator):
         except Exception:
             return g
 
+    # ----------------------------------------- aligned path (in_axis == 0)
+    # The whole pencil pipeline runs inside ONE shard_map kernel: local
+    # transforms are per-block jnp.fft calls (the SPMD partitioner
+    # replicates XLA's FFT custom-call even on non-transformed sharded
+    # operands, so the implicit path all-gathers — inside shard_map there
+    # is no partitioner) and the two pencil transposes are explicit
+    # lax.all_to_all ops, ragged axes handled by pad-to-multiple +
+    # crop-once-local (ref mpi4py-fft's ragged pencils, FFTND.py:188-211).
+
+    def _aligned_phys(self, x: DistributedArray, dims, rows) -> jax.Array:
+        """Physical flat buffer in the row-aligned layout. When ``x``
+        already carries it: the buffer itself (zero comm). Otherwise one
+        static row-gather re-packs the logical view (the rebalancing
+        cost the reference pays in its @reshaped decorator)."""
+        P = int(self.mesh.devices.size)
+        rmax = max(rows)
+        inner = int(np.prod(dims[1:]))
+        if (x.partition == Partition.SCATTER and x.axis == 0
+                and x.ndim == 1
+                and tuple(s[0] for s in x.local_shapes)
+                == tuple(r * inner for r in rows)):
+            return x._arr
+        g = x.array.reshape(dims)
+        src, valid = pad_index_map(rows, rmax)
+        cube = jnp.take(g, jnp.asarray(src), axis=0)
+        m = jnp.asarray(valid).reshape((P * rmax,) + (1,) * (cube.ndim - 1))
+        cube = jnp.where(m, cube, jnp.zeros((), dtype=cube.dtype))
+        phys = cube.reshape(-1)
+        try:
+            phys = lax.with_sharding_constraint(
+                phys, axis_sharding(self.mesh, 1, 0))
+        except Exception:
+            pass
+        return phys
+
+    def _wrap_flat(self, phys: jax.Array, dimsd, locals_, mesh,
+                   dtype) -> DistributedArray:
+        """Row-aligned physical flat buffer -> DistributedArray (the
+        C-order flatten keeps each shard's pad rows at its flat block
+        tail — exactly the pad-to-max layout DistributedArray stores)."""
+        y = DistributedArray(global_shape=int(np.prod(dimsd)), mesh=mesh,
+                             partition=Partition.SCATTER, axis=0,
+                             local_shapes=locals_, dtype=dtype)
+        y._arr = y._place(phys.astype(dtype))
+        return y
+
+    @staticmethod
+    def _block_transpose(b: jax.Array, axis_name: str, P: int,
+                         out_ax: int) -> jax.Array:
+        """Inside-kernel pencil transpose: block rows (axis 0) scatter
+        over devices, ``out_ax`` tiles gather locally (``out_ax`` padded
+        to a device multiple first)."""
+        bo = -(-b.shape[out_ax] // P)
+        tail = P * bo - b.shape[out_ax]
+        if tail:
+            padw = [(0, 0)] * b.ndim
+            padw[out_ax] = (0, tail)
+            b = jnp.pad(b, padw)
+        if P > 1:
+            b = lax.all_to_all(b, axis_name, split_axis=out_ax,
+                               concat_axis=0, tiled=True)
+        return b
+
     # --------------------------------------------------------------- apply
     def _matvec(self, x: DistributedArray) -> DistributedArray:
         if x.partition != Partition.SCATTER:
             raise ValueError(f"x should have partition={Partition.SCATTER}"
                              f" Got {x.partition} instead...")
+        if (len(self.dims_nd) > 1 and self._in_axis == 0
+                and len(self.mesh.axis_names) == 1):
+            return self._matvec_aligned(x)
+        return self._matvec_generic(x)
+
+    def _rmatvec(self, x: DistributedArray) -> DistributedArray:
+        if x.partition != Partition.SCATTER:
+            raise ValueError(f"x should have partition={Partition.SCATTER}"
+                             f" Got {x.partition} instead...")
+        if (len(self.dims_nd) > 1 and self._in_axis == 0
+                and len(self.mesh.axis_names) == 1):
+            return self._rmatvec_aligned(x)
+        return self._rmatvec_generic(x)
+
+    def _matvec_aligned(self, x: DistributedArray) -> DistributedArray:
+        """in_axis==0 pencil schedule, one shard_map kernel end to end:
+        per-block stage-1 transforms, all-to-all transpose, axis-0
+        transform, all-to-all back."""
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as PSpec
+
+        axes = [int(a) for a in self.axes]
+        shift_before = self._shift_axes(self.ifftshift_before)
+        shift_after = self._shift_axes(self.fftshift_after)
+        P = int(self.mesh.devices.size)
+        axis_name = self.mesh.axis_names[0]
+        out_ax = self._out_axis
+        rows_m, rows_d = self._rows_m, self._rows_d
+        rmax_m, rmax_d = max(rows_m), max(rows_d)
+        dims, dimsd = self.dims_nd, self.dimsd_nd
+        nfft0 = self.nffts[axes.index(0)] if 0 in axes else None
+        # in this path axes[-1] != 0 always (axes[-1]==0 forces
+        # in_axis=1), so the (r)fft axis is local in stage 1
+        stage1 = [axes[-1]] + [a for a in axes[:-1] if a != 0]
+        rows_m_arr = jnp.asarray(rows_m)
+        unpad_m = jnp.asarray(unpad_index_map(rows_m, rmax_m))
+        pad_d_src, pad_d_valid = pad_index_map(rows_d, rmax_d)
+        pad_d_src = jnp.asarray(pad_d_src)
+        pad_d_mask = jnp.asarray(pad_d_valid)
+
+        def kernel(xb):
+            b = xb.reshape((rmax_m,) + tuple(dims[1:]))
+            nrows = rows_m_arr[lax.axis_index(axis_name)]
+            row = lax.broadcasted_iota(jnp.int32, b.shape, 0)
+            b = jnp.where(row < nrows, b, jnp.zeros((), dtype=b.dtype))
+            loc_before = [a for a in shift_before if a != 0]
+            if loc_before:
+                b = jnp.fft.ifftshift(b, axes=loc_before)
+            if not self.clinear:
+                b = b.real
+            for ax in stage1:
+                nfft = self.nffts[axes.index(ax)]
+                if self.real and ax == axes[-1]:
+                    b = jnp.fft.rfft(b, n=nfft, axis=ax)
+                else:
+                    b = jnp.fft.fft(b, n=nfft, axis=ax)
+            if self.real:
+                b = self._scale_real(b, inverse=False)
+            if 0 in axes:
+                b = self._block_transpose(b, axis_name, P, out_ax)
+                b = jnp.take(b, unpad_m, axis=0)       # exact dims[0]
+                if 0 in shift_before:
+                    b = jnp.fft.ifftshift(b, axes=(0,))
+                b = jnp.fft.fft(b, n=nfft0, axis=0)    # exact dimsd[0]
+                if 0 in shift_after:
+                    b = jnp.fft.fftshift(b, axes=(0,))
+                b = jnp.take(b, pad_d_src, axis=0)     # per-shard padded
+                m = pad_d_mask.reshape((-1,) + (1,) * (b.ndim - 1))
+                b = jnp.where(m, b, jnp.zeros((), dtype=b.dtype))
+                if P > 1:
+                    b = lax.all_to_all(b, axis_name, split_axis=0,
+                                       concat_axis=out_ax, tiled=True)
+                sl = [slice(None)] * b.ndim
+                sl[out_ax] = slice(0, dimsd[out_ax])   # crop tail pad
+                b = b[tuple(sl)]
+            loc_after = [a for a in shift_after if a != 0]
+            if loc_after:
+                b = jnp.fft.fftshift(b, axes=loc_after)
+            if self.norm == "1/n":
+                b = b / self._scale
+            return b.astype(self.cdtype).reshape(-1)
+
+        phys = self._aligned_phys(x, dims, rows_m)
+        out = shard_map(kernel, mesh=self.mesh, in_specs=PSpec(axis_name),
+                        out_specs=PSpec(axis_name), check_vma=False)(phys)
+        return self._wrap_flat(out, dimsd, self._dlocals, x.mesh,
+                               self.cdtype)
+
+    def _rmatvec_aligned(self, x: DistributedArray) -> DistributedArray:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as PSpec
+
+        axes = [int(a) for a in self.axes]
+        shift_before = self._shift_axes(self.ifftshift_before)
+        shift_after = self._shift_axes(self.fftshift_after)
+        P = int(self.mesh.devices.size)
+        axis_name = self.mesh.axis_names[0]
+        out_ax = self._out_axis
+        rows_m, rows_d = self._rows_m, self._rows_d
+        rmax_m, rmax_d = max(rows_m), max(rows_d)
+        dims, dimsd = self.dims_nd, self.dimsd_nd
+        nfft0 = self.nffts[axes.index(0)] if 0 in axes else None
+        rows_d_arr = jnp.asarray(rows_d)
+        unpad_d = jnp.asarray(unpad_index_map(rows_d, rmax_d))
+        pad_m_src, pad_m_valid = pad_index_map(rows_m, rmax_m)
+        pad_m_src = jnp.asarray(pad_m_src)
+        pad_m_mask = jnp.asarray(pad_m_valid)
+
+        def kernel(xb):
+            b = xb.reshape((rmax_d,) + tuple(dimsd[1:]))
+            nrows = rows_d_arr[lax.axis_index(axis_name)]
+            row = lax.broadcasted_iota(jnp.int32, b.shape, 0)
+            b = jnp.where(row < nrows, b, jnp.zeros((), dtype=b.dtype))
+            loc_after = [a for a in shift_after if a != 0]
+            if loc_after:
+                b = jnp.fft.ifftshift(b, axes=loc_after)
+            if self.real:
+                b = self._scale_real(b, inverse=True)
+            if 0 in axes:
+                b = self._block_transpose(b, axis_name, P, out_ax)
+                b = jnp.take(b, unpad_d, axis=0)       # exact dimsd[0]
+                if 0 in shift_after:
+                    b = jnp.fft.ifftshift(b, axes=(0,))
+                b = jnp.fft.ifft(b, n=nfft0, axis=0)
+                b = b[:dims[0]]
+                if 0 in shift_before:
+                    b = jnp.fft.fftshift(b, axes=(0,))
+                b = jnp.take(b, pad_m_src, axis=0)     # per-shard padded
+                m = pad_m_mask.reshape((-1,) + (1,) * (b.ndim - 1))
+                b = jnp.where(m, b, jnp.zeros((), dtype=b.dtype))
+                if P > 1:
+                    b = lax.all_to_all(b, axis_name, split_axis=0,
+                                       concat_axis=out_ax, tiled=True)
+                sl = [slice(None)] * b.ndim
+                sl[out_ax] = slice(0, dimsd[out_ax])   # crop tail pad
+                b = b[tuple(sl)]
+            for ax in [a for a in axes[:-1] if a != 0][::-1]:
+                b = jnp.fft.ifft(b, n=self.nffts[axes.index(ax)], axis=ax)
+            if self.real:
+                b = jnp.fft.irfft(b, n=self.nffts[-1], axis=axes[-1])
+            else:
+                b = jnp.fft.ifft(b, n=self.nffts[-1], axis=axes[-1])
+            # crop local axes to model dims (nfft may exceed dims);
+            # axis 0 was cropped while assembled in the transpose stage
+            b = b[(slice(None),) + tuple(slice(0, d) for d in dims[1:])]
+            if self.norm == "none":
+                b = b * self._scale  # cancel ifft's 1/N: true adjoint
+            if not self.clinear:
+                b = b.real
+            loc_before = [a for a in shift_before if a != 0]
+            if loc_before:
+                b = jnp.fft.fftshift(b, axes=loc_before)
+            dt = self.rdtype if not self.clinear else self.cdtype
+            return b.astype(dt).reshape(-1)
+
+        phys = self._aligned_phys(x, dimsd, rows_d)
+        out = shard_map(kernel, mesh=self.mesh, in_specs=PSpec(axis_name),
+                        out_specs=PSpec(axis_name), check_vma=False)(phys)
+        dtype = self.rdtype if not self.clinear else self.cdtype
+        return self._wrap_flat(out, dims, self._mlocals, x.mesh, dtype)
+
+    def _matvec_generic(self, x: DistributedArray) -> DistributedArray:
+        """General pencil schedule on the logical global array (1-D
+        transforms and the rare in_axis==1 layout): XLA partitions the
+        traced program; the explicit transposes still pin all-to-alls."""
         g = x.array.reshape(self.dims_nd)
         if self.ifftshift_before.any():
             g = jnp.fft.ifftshift(
@@ -158,10 +450,11 @@ class _MPIBaseFFTND(MPILinearOperator):
         # other axis locally — the (r)fft axis (axes[-1]) first, on the
         # real input. Stage 2: reshard (all-to-all) so in_ax is local,
         # transform it.
+        pad = 0
         if g.ndim == 1:
             g = self._constrain_replicated(g)
         else:
-            g = self._constrain(g, in_ax)
+            g, pad = self._reshard(g, in_ax)
         stage1 = ([axes[-1]] if axes[-1] != in_ax else []) + \
             [a for a in axes[:-1] if a != in_ax]
         for ax in stage1:
@@ -171,13 +464,17 @@ class _MPIBaseFFTND(MPILinearOperator):
             else:
                 g = jnp.fft.fft(g, n=nfft, axis=ax)
         if in_ax in axes:
-            if g.ndim > 1:
-                g = self._constrain(g, self._out_axis)  # pencil transpose
+            if g.ndim > 1:  # pencil transpose; in_ax padding cropped
+                g, pad = self._reshard(g, self._out_axis, in_ax, pad)
             nfft = self.nffts[axes.index(in_ax)]
             if self.real and in_ax == axes[-1]:
                 g = jnp.fft.rfft(g, n=nfft, axis=in_ax)
             else:
                 g = jnp.fft.fft(g, n=nfft, axis=in_ax)
+            if g.ndim > 1:
+                g = self._crop(g, self._out_axis, pad)
+        elif g.ndim > 1:
+            g = self._crop(g, in_ax, pad)
         if self.real:
             g = self._scale_real(g, inverse=False)
         if self.norm == "1/n":
@@ -190,10 +487,7 @@ class _MPIBaseFFTND(MPILinearOperator):
         y[:] = g.astype(self.cdtype).ravel()
         return y
 
-    def _rmatvec(self, x: DistributedArray) -> DistributedArray:
-        if x.partition != Partition.SCATTER:
-            raise ValueError(f"x should have partition={Partition.SCATTER}"
-                             f" Got {x.partition} instead...")
+    def _rmatvec_generic(self, x: DistributedArray) -> DistributedArray:
         g = x.array.reshape(self.dimsd_nd)
         if self.fftshift_after.any():
             g = jnp.fft.ifftshift(
@@ -212,14 +506,15 @@ class _MPIBaseFFTND(MPILinearOperator):
             else:
                 g = jnp.fft.ifft(g, n=self.nffts[-1], axis=0)
         else:
+            pad = 0
             if in_ax in axes:
-                g = self._constrain(g, self._out_axis)
+                g, pad = self._reshard(g, self._out_axis)
                 nfft = self.nffts[axes.index(in_ax)]
                 if self.real and in_ax == axes[-1]:
                     g = jnp.fft.irfft(g, n=nfft, axis=in_ax)
                 else:
                     g = jnp.fft.ifft(g, n=nfft, axis=in_ax)
-            g = self._constrain(g, in_ax)
+            g, pad = self._reshard(g, in_ax, self._out_axis, pad)
             for ax in [a for a in axes[:-1] if a != in_ax][::-1]:
                 g = jnp.fft.ifft(g, n=self.nffts[axes.index(ax)], axis=ax)
             if axes[-1] != in_ax:
@@ -227,6 +522,7 @@ class _MPIBaseFFTND(MPILinearOperator):
                     g = jnp.fft.irfft(g, n=self.nffts[-1], axis=axes[-1])
                 else:
                     g = jnp.fft.ifft(g, n=self.nffts[-1], axis=axes[-1])
+            g = self._crop(g, in_ax, pad)
         # crop to model dims (nfft may exceed dims)
         idx = tuple(slice(0, d) for d in self.dims_nd)
         g = g[idx]
