@@ -139,6 +139,7 @@ class MPIBlockDiag(MPILinearOperator):
     def has_fused_normal(self) -> bool:
         from .pallas_kernels import normal_matvec_supported
         return (self._batched is not None
+                and len(self.mesh.axis_names) == 1  # shard_map kernel is 1-D
                 and normal_matvec_supported(self._batched))
 
     def normal_matvec(self, x: DistributedArray):

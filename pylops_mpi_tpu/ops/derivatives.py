@@ -66,6 +66,9 @@ class _StencilOperator(MPILinearOperator):
     def _apply(self, x: DistributedArray, forward: bool) -> DistributedArray:
         if x.partition in (Partition.BROADCAST, Partition.UNSAFE_BROADCAST):
             x = x.to_partition(Partition.SCATTER)
+        y = self._apply_explicit(x, forward)
+        if y is not None:
+            return y
         g = x.array.reshape(self.dims_nd)
         op = self._local_op()
         arr = op._matvec(g.ravel()) if forward else op._rmatvec(g.ravel())
@@ -74,6 +77,93 @@ class _StencilOperator(MPILinearOperator):
                              local_shapes=self._out_locals, mask=x.mask,
                              dtype=arr.dtype)
         y[:] = arr
+        return y
+
+    def _apply_explicit(self, x: DistributedArray,
+                        forward: bool) -> Optional[DistributedArray]:
+        """Hand-scheduled stencil path: one shard_map kernel with a
+        single ``ppermute`` pair exchanging only the boundary rows
+        (:func:`~pylops_mpi_tpu.parallel.collectives.ring_halo_extend`)
+        and one fused Pallas VMEM pass per shard
+        (:mod:`~pylops_mpi_tpu.ops.pallas_kernels`) — the explicit form
+        of the ghost-cell schedule the reference hand-codes with
+        Send/Recv (ref ``FirstDerivative.py:141-149``,
+        ``DistributedArray.py:877-954``). Applies to the centered-3,
+        ``edge=False``, axis-0, evenly-divisible case; returns ``None``
+        (generic implicit path) otherwise. Disable with
+        ``PYLOPS_MPI_TPU_EXPLICIT_STENCIL=0``."""
+        from ..utils import deps
+        if not deps.explicit_stencil_enabled():
+            return None
+        op = self._local_op()
+        first = isinstance(op, _LocalFirst)
+        if first and not (op.axis == 0 and op.kind == "centered"
+                          and op.order == 3 and not op.edge):
+            return None
+        if not first and not (isinstance(op, _LocalSecond) and op.axis == 0
+                              and op.kind == "centered" and not op.edge):
+            return None
+        if len(self.mesh.axis_names) != 1:  # 1-D ring schedule only
+            return None
+        P_ = int(self.mesh.devices.size)
+        dims = self.dims_nd
+        if (x.partition != Partition.SCATTER or x.axis != 0 or x.ndim != 1
+                or dims[0] % P_ or dims[0] // P_ < 1 or not x._even
+                or not jnp.issubdtype(x.dtype, jnp.floating)):
+            return None
+        from jax import shard_map
+        from jax import lax
+        from jax.sharding import PartitionSpec as PSpec
+        from ..parallel.collectives import ring_halo_extend
+        from .pallas_kernels import (first_derivative_centered,
+                                     second_derivative)
+
+        rows = dims[0] // P_
+        axis_name = self.mesh.axis_names[0]
+        s = op.sampling
+        import jax as _jax
+        on_tpu = _jax.default_backend() == "tpu"
+        if first:
+            def stencil(g):
+                # Pallas: one fused VMEM pass on TPU; the direct jnp form
+                # elsewhere (interpret-mode Pallas is test-only slow)
+                if on_tpu:
+                    return first_derivative_centered(g, axis=0,
+                                                     sampling=s)[1:-1]
+                return (g[2:] - g[:-2]) / (2.0 * s)
+        else:
+            def stencil(g):
+                if on_tpu:
+                    return second_derivative(g, axis=0, sampling=s)[1:-1]
+                return (g[2:] - 2.0 * g[1:-1] + g[:-2]) / s ** 2
+        # centered-3 first derivative is antisymmetric: the adjoint is
+        # the negated stencil applied to the edge-zeroed input; the
+        # second derivative's 3-point core is symmetric
+        sign = -1.0 if (first and not forward) else 1.0
+
+        def kernel(xb):
+            b = xb.reshape((rows,) + tuple(dims[1:]))
+            idx = lax.axis_index(axis_name)
+            row = lax.broadcasted_iota(jnp.int32, b.shape, 0)
+            gedge = (idx * rows + row == 0) | \
+                (idx * rows + row == dims[0] - 1)
+            if not forward:  # adjoint: zero rows the forward never wrote
+                b = jnp.where(gedge, jnp.zeros((), b.dtype), b)
+            g = ring_halo_extend(b, axis_name, P_, 1, 1)
+            y = stencil(g)
+            if sign != 1.0:
+                y = -y
+            if forward:  # edge=False: boundary rows are zero
+                y = jnp.where(gedge, jnp.zeros((), y.dtype), y)
+            return y.reshape(-1)
+
+        out = shard_map(kernel, mesh=self.mesh, in_specs=PSpec(axis_name),
+                        out_specs=PSpec(axis_name), check_vma=False)(x._arr)
+        y = DistributedArray(global_shape=self.shape[0], mesh=self.mesh,
+                             partition=Partition.SCATTER, axis=0,
+                             local_shapes=self._out_locals, mask=x.mask,
+                             dtype=out.dtype)
+        y._arr = y._place(out)
         return y
 
     def _matvec(self, x: DistributedArray) -> DistributedArray:

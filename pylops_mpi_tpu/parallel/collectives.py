@@ -4,122 +4,41 @@ TPU-native equivalent of the reference's L0/L1 communication stack
 (``pylops_mpi/Distributed.py:24-349``, ``utils/_mpi.py``,
 ``utils/_nccl.py``): one backend — XLA collectives over ICI/DCN — instead
 of the MPI/NCCL dual dispatch. The implicit path (GSPMD partitioning of
-plain ``jnp`` ops on sharded arrays) covers most of the library; these
-explicit wrappers exist for the hot kernels that want a hand-written
-schedule (halo exchange, SUMMA, pencil FFT) and for tests.
+plain ``jnp`` ops on sharded arrays) covers most of the library; this
+module holds only the hand-scheduled primitives the hot kernels consume:
+
+- :func:`all_to_all_resharding` — the pencil transpose of the
+  distributed FFTs (``ops/fft.py``) and ``redistribute``'s pattern;
+- :func:`ring_halo_extend` / :func:`cart_halo_extend` — in-kernel
+  neighbour (ghost-cell) exchanges used by the stencil fast path
+  (``ops/derivatives.py``) and the N-D Cartesian halo (``ops/halo.py``).
+
+Generic allreduce/allgather wrappers existed in round 1 but had no
+production call sites (reductions lower to ``psum`` through GSPMD
+already) and were removed rather than kept as padding.
 
 Sub-communicator semantics (``MPI.Comm.Split`` / ``nccl_split``,
 ref ``pylops_mpi/DistributedArray.py:74-100``, ``utils/_nccl.py:135-165``)
-are expressed with ``axis_index_groups``.
+are expressed with segment reductions / ``axis_index_groups`` at the
+call sites that need them (``DistributedArray._reduce``).
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Callable, List, Optional, Sequence
+from typing import Sequence
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 __all__ = [
-    "groups_from_mask",
-    "allreduce",
-    "allgather",
-    "ppermute_shift",
     "all_to_all_resharding",
-    "ring_halo",
+    "ring_halo_extend",
     "cart_halo_extend",
 ]
-
-
-def groups_from_mask(mask: Sequence[int]) -> List[List[int]]:
-    """Convert the reference's rank-coloring ``mask`` (a list assigning a
-    group id to every shard, ref ``DistributedArray.py:74-100``) into the
-    ``axis_index_groups`` format XLA collectives accept."""
-    groups: dict = {}
-    for rank, color in enumerate(mask):
-        groups.setdefault(color, []).append(rank)
-    return [groups[color] for color in sorted(groups)]
-
-
-def allreduce(x: jax.Array, mesh: Mesh, axis: int = 0,
-              op: str = "sum", mask: Optional[Sequence[int]] = None) -> jax.Array:
-    """Sum/max/min-allreduce of per-shard partial reductions along the
-    sharded axis, via an explicit shard_map kernel.
-
-    Equivalent of ``DistributedMixIn._allreduce(_subcomm)``
-    (ref ``pylops_mpi/Distributed.py:70-135``).
-    """
-    axis_name = mesh.axis_names[0]
-    groups = groups_from_mask(mask) if mask is not None else None
-    reducer = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin}[op]
-    local_red = {"sum": jnp.sum, "max": jnp.max, "min": jnp.min}[op]
-
-    in_spec = [None] * x.ndim
-    in_spec[axis] = axis_name
-
-    if groups is None:
-        def kernel(xs):
-            r = local_red(xs, axis=axis)
-            return reducer(r, axis_name)
-
-        return shard_map(kernel, mesh=mesh, in_specs=P(*in_spec),
-                         out_specs=P())(x)
-
-    # per-group reductions differ across devices, so the result stays
-    # sharded: entry i of the returned (P,)-vector is the reduction over
-    # the group shard i belongs to (what rank i would see in the
-    # reference's sub-communicator allreduce)
-    def kernel(xs):
-        r = local_red(xs, axis=axis)
-        return reducer(r, axis_name, axis_index_groups=groups)[None]
-
-    # check_vma off: grouped psum's per-device-varying result defeats the
-    # replication checker
-    return shard_map(kernel, mesh=mesh, in_specs=P(*in_spec),
-                     out_specs=P(axis_name), check_vma=False)(x)
-
-
-def allgather(x: jax.Array, mesh: Mesh, axis: int = 0) -> jax.Array:
-    """Gather the sharded axis onto every device (replicated result).
-
-    Equivalent of ``DistributedMixIn._allgather``
-    (ref ``pylops_mpi/Distributed.py:137-200``); the ragged-shard
-    Allgatherv-with-displacements machinery (``utils/_mpi.py:21-67``) is
-    unnecessary — GSPMD's pad-and-slice handles uneven shards.
-    """
-    axis_name = mesh.axis_names[0]
-    in_spec = [None] * x.ndim
-    in_spec[axis] = axis_name
-
-    def kernel(xs):
-        return lax.all_gather(xs, axis_name, axis=axis, tiled=True)
-
-    fn = shard_map(kernel, mesh=mesh, in_specs=P(*in_spec), out_specs=P(),
-                   check_vma=False)
-    return fn(x)
-
-
-def ppermute_shift(x: jax.Array, mesh: Mesh, shift: int = 1) -> jax.Array:
-    """Rotate shards along the mesh axis by ``shift`` (ring exchange).
-
-    The one-controller analog of the reference's neighbor
-    ``Send``/``Recv`` pairs in ``add_ghost_cells``
-    (ref ``pylops_mpi/DistributedArray.py:877-954``).
-    """
-    axis_name = mesh.axis_names[0]
-    n = mesh.devices.size
-
-    def kernel(xs):
-        perm = [(i, (i + shift) % n) for i in range(n)]
-        return lax.ppermute(xs, axis_name, perm)
-
-    spec = P(*([axis_name] + [None] * (x.ndim - 1)))
-    return shard_map(kernel, mesh=mesh, in_specs=spec, out_specs=spec)(x)
 
 
 def all_to_all_resharding(x: jax.Array, mesh: Mesh,
@@ -198,53 +117,37 @@ def cart_halo_extend(block: jax.Array, axis_name: str,
     return jnp.concatenate(parts, axis=ax)
 
 
-def ring_halo(x: jax.Array, mesh: Mesh, front: int = 0, back: int = 0):
-    """Explicit ring halo exchange over the sharded axis 0: each shard
-    receives its predecessor's last ``front`` rows and its successor's
-    first ``back`` rows, zero-filled at the domain edges.
-
-    One `ppermute`` hop per direction — the structural analog of ring
-    attention's neighbour pass, and the explicit form of the ghost-cell
-    Send/Recv chain in ref ``pylops_mpi/DistributedArray.py:877-954``
-    (XLA emits the same transfers implicitly for the fused stencils; this
-    primitive exists for hand-scheduled kernels and benchmarks).
-
-    Returns ``(front_ghosts, back_ghosts)``: arrays sharded like ``x``
-    whose per-shard blocks are the ghost rows (``P*front`` / ``P*back``
-    global rows).
-    """
-    axis_name = mesh.axis_names[0]
-    n = int(mesh.devices.size)
-    spec = P(*([axis_name] + [None] * (x.ndim - 1)))
-
-    def kernel(xs):
-        idx = lax.axis_index(axis_name)
-        outs = []
-        if front:
-            fwd = [(i, (i + 1) % n) for i in range(n)]
-            recv = lax.ppermute(xs[-front:], axis_name, fwd)
-            recv = jnp.where(
-                (idx == 0) * jnp.ones((1,) * xs.ndim, dtype=bool),
-                jnp.zeros_like(recv), recv)
-            outs.append(recv)
-        else:
-            outs.append(None)
-        if back:
-            bwd = [(i, (i - 1) % n) for i in range(n)]
-            recv = lax.ppermute(xs[:back], axis_name, bwd)
-            recv = jnp.where(
-                (idx == n - 1) * jnp.ones((1,) * xs.ndim, dtype=bool),
-                jnp.zeros_like(recv), recv)
-            outs.append(recv)
-        else:
-            outs.append(None)
-        return tuple(o for o in outs if o is not None)
-
-    nouts = (1 if front else 0) + (1 if back else 0)
-    out_specs = tuple(spec for _ in range(nouts))
-    res = shard_map(kernel, mesh=mesh, in_specs=spec, out_specs=out_specs,
-                    check_vma=False)(x)
-    res = list(res)
-    fg = res.pop(0) if front else None
-    bg = res.pop(0) if back else None
-    return fg, bg
+def ring_halo_extend(block, axis_name: str, n_shards: int,
+                     front: int = 0, back: int = 0):
+    """In-kernel ring ghost exchange over the 1-D mesh axis: extends the
+    local ``block`` along array axis 0 with the predecessor's last
+    ``front`` rows and the successor's first ``back`` rows, zero-filled
+    at the domain edges — one ``ppermute`` hop per direction, boundary
+    slabs only. The structural analog of ring attention's neighbour pass
+    and the explicit form of the ghost-cell Send/Recv chain in ref
+    ``pylops_mpi/DistributedArray.py:877-954``. Call inside a
+    ``shard_map`` kernel (production consumer: the stencil fast path in
+    ``ops/derivatives.py``; the N-D generalisation is
+    :func:`cart_halo_extend`)."""
+    n = int(n_shards)
+    if (front == 0 and back == 0):
+        return block
+    if n == 1:
+        padw = [(front, back)] + [(0, 0)] * (block.ndim - 1)
+        return jnp.pad(block, padw)
+    idx = lax.axis_index(axis_name)
+    parts = []
+    if front:
+        fwd = [(i, i + 1) for i in range(n - 1)]
+        recv = lax.ppermute(block[-front:], axis_name, fwd)
+        parts.append(jnp.where(
+            (idx == 0) * jnp.ones((1,) * block.ndim, dtype=bool),
+            jnp.zeros_like(recv), recv))
+    parts.append(block)
+    if back:
+        bwd = [(i, i - 1) for i in range(1, n)]
+        recv = lax.ppermute(block[:back], axis_name, bwd)
+        parts.append(jnp.where(
+            (idx == n - 1) * jnp.ones((1,) * block.ndim, dtype=bool),
+            jnp.zeros_like(recv), recv))
+    return jnp.concatenate(parts, axis=0)

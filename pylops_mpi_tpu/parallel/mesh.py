@@ -157,11 +157,14 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 def axis_sharding(mesh: Mesh, ndim: int, axis: int,
                   axis_name: Optional[str] = None) -> NamedSharding:
     """NamedSharding that shards dimension ``axis`` of an ``ndim`` array
-    over ``axis_name`` (default: the mesh's single axis)."""
+    over ``axis_name``. Default: the mesh's single axis, or — on a
+    multi-level mesh (e.g. ``make_mesh_hybrid``'s dcn×ici) — the product
+    of ALL mesh axes in outer-to-inner order, so one logical shard axis
+    spans every device and the device-order block layout matches the
+    1-D case."""
     if axis_name is None:
-        if len(mesh.axis_names) != 1:
-            raise ValueError("axis_name required for multi-axis mesh")
-        axis_name = mesh.axis_names[0]
+        axis_name = mesh.axis_names[0] if len(mesh.axis_names) == 1 \
+            else tuple(mesh.axis_names)
     spec = [None] * ndim
     spec[axis] = axis_name
     return NamedSharding(mesh, P(*spec))

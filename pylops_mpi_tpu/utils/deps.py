@@ -20,13 +20,21 @@ from __future__ import annotations
 import os
 
 __all__ = ["jax_enabled", "platform_override", "x64_enabled",
-           "apply_environment"]
+           "explicit_stencil_enabled", "apply_environment"]
 
 jax_enabled = True  # the only engine; mirrors deps.nccl_enabled's role
 
 
 def platform_override():
     return os.environ.get("PYLOPS_MPI_TPU_PLATFORM")
+
+
+def explicit_stencil_enabled() -> bool:
+    """Hand-scheduled shard_map (ring-halo ppermute + Pallas) stencil
+    path for the axis-0 derivatives; set
+    ``PYLOPS_MPI_TPU_EXPLICIT_STENCIL=0`` to force the implicit
+    (GSPMD-partitioned) formulation."""
+    return os.environ.get("PYLOPS_MPI_TPU_EXPLICIT_STENCIL", "1") != "0"
 
 
 def x64_enabled() -> bool:

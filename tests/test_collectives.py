@@ -1,11 +1,16 @@
 """Explicit collective primitive tests (shard_map layer) — the analog of
 the reference's NCCL-primitive unit tests
-(``tests_nccl/test_ncclutils_nccl.py``)."""
+(``tests_nccl/test_ncclutils_nccl.py``). The module holds only the
+hand-scheduled primitives with production consumers: the pencil
+transpose (FFTs), and the ring / Cartesian halo extends (stencil fast
+path, MPIHalo)."""
 
 import numpy as np
 import pytest
 import jax
 import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import PartitionSpec as P
 
 from pylops_mpi_tpu.parallel import collectives as C
 from pylops_mpi_tpu.parallel.mesh import make_mesh
@@ -16,100 +21,78 @@ def mesh():
     return make_mesh()
 
 
-def test_allreduce_sum(mesh, rng):
-    x = jnp.asarray(rng.standard_normal(32))
-    np.testing.assert_allclose(np.asarray(C.allreduce(x, mesh)), x.sum(),
-                               rtol=1e-12)
-
-
-@pytest.mark.parametrize("op", ["max", "min"])
-def test_allreduce_maxmin(mesh, rng, op):
-    x = jnp.asarray(rng.standard_normal(16))
-    expected = getattr(np, op)(np.asarray(x))
-    np.testing.assert_allclose(np.asarray(C.allreduce(x, mesh, op=op)),
-                               expected)
-
-
-def test_allreduce_masked(mesh, rng):
-    """Per-group allreduce returns each shard its group's sum
-    (regression: needs a sharded out_spec)."""
-    mask = [0, 0, 0, 0, 1, 1, 1, 1]
-    x = jnp.asarray(rng.standard_normal(32))
-    got = np.asarray(C.allreduce(x, mesh, mask=mask))
-    assert got.shape == (8,)
-    g0 = np.asarray(x[:16]).sum()
-    g1 = np.asarray(x[16:]).sum()
-    np.testing.assert_allclose(got, [g0] * 4 + [g1] * 4, rtol=1e-12)
-
-
-def test_allgather(mesh, rng):
-    x = jnp.asarray(rng.standard_normal((16, 3)))
-    got = C.allgather(x, mesh, axis=0)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(x))
-
-
-def test_ppermute_shift(mesh, rng):
-    x = jnp.asarray(rng.standard_normal((8, 4)))
-    got = np.asarray(C.ppermute_shift(x, mesh, shift=1))
-    np.testing.assert_allclose(got, np.roll(np.asarray(x), 1, axis=0))
-
-
 def test_all_to_all_resharding(mesh, rng):
     x = jnp.asarray(rng.standard_normal((8, 16)))
     got = C.all_to_all_resharding(x, mesh, old_axis=0, new_axis=1)
     np.testing.assert_allclose(np.asarray(got), np.asarray(x))
 
 
-def test_groups_from_mask():
-    assert C.groups_from_mask([0, 0, 1, 1]) == [[0, 1], [2, 3]]
-    assert C.groups_from_mask([1, 0, 1, 0]) == [[1, 3], [0, 2]]
+def test_all_to_all_resharding_3d(mesh, rng):
+    x = jnp.asarray(rng.standard_normal((16, 8, 3)))
+    got = C.all_to_all_resharding(x, mesh, old_axis=1, new_axis=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(x))
 
 
-def test_ring_halo(mesh, rng):
-    """Explicit ring ghost exchange matches the logical ghost-cell
-    semantics (zero at domain edges)."""
-    import jax.numpy as jnp
-    from pylops_mpi_tpu.parallel.collectives import ring_halo
+def _run_ring(mesh, x, front, back):
+    name = mesh.axis_names[0]
+    n = int(mesh.devices.size)
+
+    def kernel(xb):
+        return C.ring_halo_extend(xb, name, n, front, back)
+
+    return np.asarray(shard_map(
+        kernel, mesh=mesh, in_specs=P(name), out_specs=P(name),
+        check_vma=False)(x))
+
+
+def test_ring_halo_extend(mesh, rng):
+    """Each shard's block is extended with the predecessor's last row
+    and the successor's first row; zeros at the domain edges."""
     x = jnp.asarray(rng.standard_normal((16, 3)))
-    fg, bg = ring_halo(x, mesh, front=1, back=1)
-    xv = np.asarray(x)
-    fgv, bgv = np.asarray(fg), np.asarray(bg)
-    # shard i front ghost = last row of shard i-1 (zeros for i=0)
+    got = _run_ring(mesh, x, 1, 1).reshape(8, 4, 3)
+    xv = np.asarray(x).reshape(8, 2, 3)
     for i in range(8):
-        if i == 0:
-            np.testing.assert_allclose(fgv[0], 0)
-        else:
-            np.testing.assert_allclose(fgv[i], xv[2 * i - 1])
-        if i == 7:
-            np.testing.assert_allclose(bgv[7], 0)
-        else:
-            np.testing.assert_allclose(bgv[i], xv[2 * (i + 1)])
+        exp_front = np.zeros(3) if i == 0 else xv[i - 1, -1]
+        exp_back = np.zeros(3) if i == 7 else xv[i + 1, 0]
+        np.testing.assert_allclose(got[i, 0], exp_front)
+        np.testing.assert_allclose(got[i, 1:3], xv[i])
+        np.testing.assert_allclose(got[i, 3], exp_back)
 
 
-def test_ring_halo_stencil_equivalence(mesh, rng):
-    """Ghosted ring segments reproduce the centered stencil."""
-    import jax.numpy as jnp
-    from pylops_mpi_tpu.parallel.collectives import ring_halo
+def test_ring_halo_extend_stencil(mesh, rng):
+    """Ghosted blocks reproduce the global centered stencil on interior
+    rows."""
     x = jnp.asarray(rng.standard_normal(32))
-    fg, bg = ring_halo(x, mesh, front=1, back=1)
-    xv = np.asarray(x).reshape(8, 4)
-    fgv = np.asarray(fg).reshape(8, 1)
-    bgv = np.asarray(bg).reshape(8, 1)
-    ghosted = np.concatenate([fgv, xv, bgv], axis=1)
-    mid = (ghosted[:, 2:] - ghosted[:, :-2]) / 2
-    got = mid.ravel()
+    got = _run_ring(mesh, x, 1, 1).reshape(8, 6)
+    mid = (got[:, 2:] - got[:, :-2]) / 2
     expected = np.zeros(32)
     expected[1:-1] = (np.asarray(x)[2:] - np.asarray(x)[:-2]) / 2
-    # interior shard boundaries must match exactly; domain edges use the
-    # zero ghosts (row 0 and row 31 differ by design)
-    np.testing.assert_allclose(got[1:-1], expected[1:-1], rtol=1e-12)
+    np.testing.assert_allclose(mid.ravel()[1:-1], expected[1:-1],
+                               rtol=1e-12)
+
+
+def test_ring_halo_extend_emits_ppermute_only(mesh, rng):
+    """The lowered exchange is collective-permute of boundary slabs —
+    no all-gather."""
+    name = mesh.axis_names[0]
+    n = int(mesh.devices.size)
+
+    def f(x):
+        def kernel(xb):
+            return C.ring_halo_extend(xb, name, n, 1, 1)
+        return shard_map(kernel, mesh=mesh, in_specs=P(name),
+                         out_specs=P(name), check_vma=False)(x)
+
+    x = jnp.asarray(rng.standard_normal(64))
+    hlo = jax.jit(f).lower(x).compile().as_text()
+    assert "collective-permute" in hlo
+    assert "all-gather" not in hlo
 
 
 def test_make_mesh_hybrid_single_host():
     """Single-process fallback: (1, n_devices) 2-level mesh with the
     DCN axis degenerate; ICI-axis sharding still works end to end."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding
     from pylops_mpi_tpu import make_mesh_hybrid
     mesh = make_mesh_hybrid()
     assert mesh.axis_names == ("dcn", "sp")
