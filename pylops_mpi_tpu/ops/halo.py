@@ -86,6 +86,11 @@ class MPIHalo(MPILinearOperator):
         self.ndim = len(self.global_dims)
         from ..parallel.mesh import default_mesh
         self.mesh = mesh if mesh is not None else default_mesh()
+        if len(self.mesh.axis_names) != 1:
+            raise ValueError(
+                "MPIHalo requires a single-axis (1-D) mesh: its shard_map "
+                "kernels index the flat Cartesian rank grid over one mesh "
+                "axis; flatten the hybrid mesh or pass make_mesh()")
         P_ = int(self.mesh.devices.size)
         if proc_grid_shape is None:
             proc_grid_shape = (1,) * (self.ndim - 1) + (P_,)

@@ -84,33 +84,29 @@ def MPINonStationaryConvolve1D(dims, hs, ih, axis: int = -1, mesh=None,
     HOp = MPIHalo(dims=dims, halo=halo, proc_grid_shape=proc_grid_shape,
                   mesh=mesh, dtype=dtype)
 
-    # per-shard local operators on the haloed extents, with the filter
-    # bank overlapped by one filter on each side (ref 156-184)
+    # Per-shard local operators on the haloed extents. The reference
+    # overlaps the filter bank by exactly ONE filter per side
+    # (ref 156-184) — insufficient when the halo spans more than one
+    # filter spacing: the forward spreads each INPUT sample through its
+    # own interpolated filter, so ghost rows up to ``halo`` outside the
+    # shard need every filter within one spacing of the extended block,
+    # or their interpolation silently clamps and boundary outputs drift
+    # (reproduced with nh=7, spacing 4). Here the window is derived from
+    # the block's actual coverage instead.
     cops = []
     for r in range(size):
         start = r * dims_local
-        ihidx = ihidx_all[r]
+        end = start + dims_local - 1
+        front = halo if r > 0 else 0
+        back = halo if r < size - 1 else 0
+        sel = np.where((ih >= start - front - ihdiff)
+                       & (ih <= end + back + ihdiff))[0]
         dims_ns = list(dims)
-        if size == 1:
-            dims_ns[axis] = dims_local + halo
-            cop = NonStationaryConvolve1D(dims_ns, hs, ih, axis=axis,
-                                          dtype=dtype)
-        elif r == 0:
-            dims_ns[axis] = dims_local + halo
-            cop = NonStationaryConvolve1D(
-                dims_ns, hs[:ihidx[-1] + 2], ih[:ihidx[-1] + 2],
-                axis=axis, dtype=dtype)
-        elif r == size - 1:
-            dims_ns[axis] = dims_local + halo
-            cop = NonStationaryConvolve1D(
-                dims_ns, hs[ihidx[0] - 1:],
-                ih[ihidx[0] - 1:] - start + halo, axis=axis, dtype=dtype)
-        else:
-            dims_ns[axis] = dims_local + 2 * halo
-            cop = NonStationaryConvolve1D(
-                dims_ns, hs[ihidx[0] - 1: ihidx[-1] + 2],
-                ih[ihidx[0] - 1: ihidx[-1] + 2] - start + halo,
-                axis=axis, dtype=dtype)
+        dims_ns[axis] = dims_local + front + back
+        cop = NonStationaryConvolve1D(
+            dims_ns, hs[sel[0]:sel[-1] + 1],
+            ih[sel[0]:sel[-1] + 1] - (start - front), axis=axis,
+            dtype=dtype)
         cops.append(cop)
 
     COp_full = MPIBlockDiag(cops, mesh=mesh)

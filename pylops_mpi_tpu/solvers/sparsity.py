@@ -61,8 +61,10 @@ def _hardthreshold(x: jax.Array, thresh) -> jax.Array:
 
 def _halfthreshold(x: jax.Array, thresh) -> jax.Array:
     arg = jnp.clip((thresh / 8.0) * (jnp.abs(x) / 3.0) ** (-1.5), -1.0, 1.0)
+    # Xu et al. half-thresholding: h(x) = 2/3 x (1 + cos(2π/3 − 2/3 φ)),
+    # φ = arccos((λ/8)(|x|/3)^(−3/2)); a 2·φ here diverges the iteration
     phi = 2.0 / 3.0 * jnp.arccos(arg)
-    x1 = 2.0 / 3.0 * x * (1 + jnp.cos(2.0 * jnp.pi / 3.0 - 2.0 * phi))
+    x1 = 2.0 / 3.0 * x * (1 + jnp.cos(2.0 * jnp.pi / 3.0 - phi))
     cut = (54 ** (1.0 / 3.0) / 4.0) * thresh ** (2.0 / 3.0)
     return jnp.where(jnp.abs(x) <= cut, 0.0, x1)
 

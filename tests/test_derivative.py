@@ -9,39 +9,104 @@ import jax.numpy as jnp
 from pylops_mpi_tpu import (DistributedArray, Partition, MPIFirstDerivative,
                             MPISecondDerivative, MPILaplacian, MPIGradient,
                             dottest)
-from pylops_mpi_tpu.ops.local import FirstDerivative as LocalFirst
-from pylops_mpi_tpu.ops.local import SecondDerivative as LocalSecond
 
 
-def _dense(op):
-    n = op.shape[1]
-    eye = np.eye(n)
-    cols = [np.asarray(op._matvec(jnp.asarray(eye[:, i]))) for i in range(n)]
-    return np.stack(cols, axis=1)
+def _first_deriv_dense(n, sampling, kind, edge, order=3):
+    """Independent NumPy dense stencil matrix for the first derivative
+    (pylops semantics, ref FirstDerivative.py:18-318)."""
+    D = np.zeros((n, n))
+    if kind == "forward":
+        for i in range(n - 1):
+            D[i, i], D[i, i + 1] = -1, 1
+        D /= sampling
+    elif kind == "backward":
+        for i in range(1, n):
+            D[i, i - 1], D[i, i] = -1, 1
+        D /= sampling
+    elif order == 3:
+        for i in range(1, n - 1):
+            D[i, i - 1], D[i, i + 1] = -0.5, 0.5
+        if edge:
+            D[0, 0], D[0, 1] = -1, 1
+            D[-1, -2], D[-1, -1] = -1, 1
+        D /= sampling
+    else:  # centered 5-point
+        for i in range(2, n - 2):
+            D[i, i - 2], D[i, i - 1] = 1 / 12, -8 / 12
+            D[i, i + 1], D[i, i + 2] = 8 / 12, -1 / 12
+        if edge:
+            D[0, 0], D[0, 1] = -1, 1
+            D[1, 0], D[1, 2] = -0.5, 0.5
+            D[-2, -3], D[-2, -1] = -0.5, 0.5
+            D[-1, -2], D[-1, -1] = -1, 1
+        D /= sampling
+    return D
 
 
 @pytest.mark.parametrize("kind", ["forward", "backward", "centered"])
 @pytest.mark.parametrize("order", [3, 5])
 @pytest.mark.parametrize("edge", [False, True])
-def test_first_derivative_1d(rng, kind, order, edge):
+@pytest.mark.parametrize("dims", [(40,), (16, 3)])
+def test_first_derivative_vs_dense(rng, kind, order, edge, dims):
+    """Sweep kind x order x edge x ndim against independently-built
+    dense stencil matrices (ref tests/test_derivative.py's 477-LoC
+    parametrization)."""
     if kind != "centered" and order == 5:
         pytest.skip("order only applies to centered")
-    n = 40
-    Fop = MPIFirstDerivative(n, sampling=0.5, kind=kind, edge=edge,
+    n = int(np.prod(dims))
+    Fop = MPIFirstDerivative(dims, sampling=0.5, kind=kind, edge=edge,
                              order=order, dtype=np.float64)
-    Flocal = LocalFirst((n,), sampling=0.5, kind=kind, edge=edge, order=order,
-                        dtype=np.float64)
+    D1 = _first_deriv_dense(dims[0], 0.5, kind, edge, order)
+    D = D1 if len(dims) == 1 else np.kron(D1, np.eye(dims[1]))
     x = rng.standard_normal(n)
     dx = DistributedArray.to_dist(x)
-    np.testing.assert_allclose(Fop.matvec(dx).asarray(),
-                               np.asarray(Flocal.matvec(jnp.asarray(x))),
-                               rtol=1e-12)
-    np.testing.assert_allclose(Fop.rmatvec(dx).asarray(),
-                               np.asarray(Flocal.rmatvec(jnp.asarray(x))),
-                               rtol=1e-12)
+    np.testing.assert_allclose(Fop.matvec(dx).asarray(), D @ x,
+                               rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(Fop.rmatvec(dx).asarray(), D.T @ x,
+                               rtol=1e-12, atol=1e-12)
     u = DistributedArray.to_dist(rng.standard_normal(n))
     v = DistributedArray.to_dist(rng.standard_normal(n))
     dottest(Fop, u, v)
+
+
+@pytest.mark.parametrize("kind", ["forward", "backward", "centered"])
+def test_first_derivative_ragged(rng, kind):
+    """Global size not divisible by the mesh: implicit path, dense
+    oracle."""
+    n = 29
+    Fop = MPIFirstDerivative(n, sampling=1.5, kind=kind, dtype=np.float64)
+    D = _first_deriv_dense(n, 1.5, kind, False)
+    x = rng.standard_normal(n)
+    dx = DistributedArray.to_dist(x)
+    np.testing.assert_allclose(Fop.matvec(dx).asarray(), D @ x,
+                               rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(Fop.rmatvec(dx).asarray(), D.T @ x,
+                               rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("kind", ["forward", "centered"])
+@pytest.mark.parametrize("edge", [False, True])
+def test_gradient_kinds(rng, kind, edge):
+    """MPIGradient forwards kind/edge to every axis derivative
+    (ref Gradient.py:100-118)."""
+    dims = (8, 6)
+    Gop = MPIGradient(dims, sampling=(1.0, 2.0), kind=kind, edge=edge,
+                      dtype=np.float64)
+    D0 = np.kron(_first_deriv_dense(dims[0], 1.0, kind, edge),
+                 np.eye(dims[1]))
+    D1 = np.kron(np.eye(dims[0]),
+                 _first_deriv_dense(dims[1], 2.0, kind, edge))
+    x = rng.standard_normal(np.prod(dims))
+    dx = DistributedArray.to_dist(x)
+    y = Gop.matvec(dx)
+    np.testing.assert_allclose(y[0].asarray(), D0 @ x, rtol=1e-12,
+                               atol=1e-12)
+    np.testing.assert_allclose(y[1].asarray(), D1 @ x, rtol=1e-12,
+                               atol=1e-12)
+    # adjoint of the stack
+    np.testing.assert_allclose(Gop.rmatvec(y).asarray(),
+                               D0.T @ (D0 @ x) + D1.T @ (D1 @ x),
+                               rtol=1e-11, atol=1e-11)
 
 
 def test_first_derivative_nd(rng):
@@ -175,31 +240,29 @@ def test_gradient(rng):
     np.testing.assert_allclose(got, expected, rtol=1e-12)
 
 
-def test_explicit_stencil_parity_and_hlo(rng):
+def test_explicit_stencil_parity_and_hlo(rng, monkeypatch):
     """The hand-scheduled ring-halo+Pallas stencil path (round-1 VERDICT
     weak #3/#4: explicit collectives and Pallas kernels now carry the
     production axis-0 centered stencils) matches the implicit path and
     lowers to boundary-slab collective-permutes with no all-gather."""
-    import os
     import jax
     n = 64
     x = rng.standard_normal(n)
     dx = DistributedArray.to_dist(x)
     for Op in (MPIFirstDerivative(n, sampling=0.5, dtype=np.float64),
                MPISecondDerivative(n, sampling=2.0, dtype=np.float64)):
+        monkeypatch.setenv("PYLOPS_MPI_TPU_EXPLICIT_STENCIL", "1")
         fwd = Op.matvec(dx).asarray()
         adj = Op.rmatvec(dx).asarray()
-        os.environ["PYLOPS_MPI_TPU_EXPLICIT_STENCIL"] = "0"
-        try:
-            np.testing.assert_allclose(Op.matvec(dx).asarray(), fwd,
-                                       rtol=1e-12, atol=1e-12)
-            np.testing.assert_allclose(Op.rmatvec(dx).asarray(), adj,
-                                       rtol=1e-12, atol=1e-12)
-        finally:
-            del os.environ["PYLOPS_MPI_TPU_EXPLICIT_STENCIL"]
         hlo = jax.jit(Op._matvec).lower(dx).compile().as_text()
         assert "collective-permute" in hlo
         assert "all-gather" not in hlo
+        monkeypatch.setenv("PYLOPS_MPI_TPU_EXPLICIT_STENCIL", "0")
+        np.testing.assert_allclose(Op.matvec(dx).asarray(), fwd,
+                                   rtol=1e-12, atol=1e-12)
+        np.testing.assert_allclose(Op.rmatvec(dx).asarray(), adj,
+                                   rtol=1e-12, atol=1e-12)
+        monkeypatch.delenv("PYLOPS_MPI_TPU_EXPLICIT_STENCIL")
 
 
 def test_explicit_stencil_nd_and_fallbacks(rng):

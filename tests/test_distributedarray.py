@@ -226,3 +226,147 @@ def test_uneven_trace_is_size_independent(rng):
     n_rav_even = len(jax.make_jaxpr(lambda d: d.ravel().array)(
         DistributedArray.to_dist(rng.standard_normal((16, 5)))).eqns)
     assert n_rav - n_rav_even <= 6, (n_rav_even, n_rav)
+
+
+# ------------------------------------------------- extended parity sweep
+# (ref tests/test_distributedarray.py: 600+ LoC of partition/norm/
+#  redistribute parametrizations)
+
+@pytest.mark.parametrize("ordd", [0, 1, 2, 3, np.inf, -np.inf])
+@pytest.mark.parametrize("n", [64, 61])
+def test_norm_ords_ragged(rng, ordd, n):
+    """All norm orders on even and ragged flat splits
+    (ref _compute_vector_norm, DistributedArray.py:689-759)."""
+    x = rng.standard_normal(n)
+    dx = DistributedArray.to_dist(x)
+    got = float(dx.norm(ordd))
+    if ordd == 0:
+        expected = float(np.count_nonzero(x))
+    else:
+        expected = float(np.linalg.norm(x, ordd))
+    np.testing.assert_allclose(got, expected, rtol=1e-10)
+
+
+@pytest.mark.parametrize("axis", [0, 1])
+@pytest.mark.parametrize("ordd", [1, 2, np.inf])
+def test_norm_axis_sweep(rng, axis, ordd):
+    x = rng.standard_normal((16, 10))
+    dx = DistributedArray.to_dist(x, axis=0)
+    got = np.asarray(dx.norm(ordd, axis=axis))
+    expected = np.linalg.norm(x, ordd, axis=axis)
+    np.testing.assert_allclose(got, expected, rtol=1e-10)
+
+
+@pytest.mark.parametrize("shape,ax_from,ax_to", [
+    ((16, 8), 0, 1), ((16, 8), 1, 0), ((8, 4, 6), 0, 2), ((13, 7), 0, 1)])
+def test_redistribute_sweep(rng, shape, ax_from, ax_to):
+    """Axis redistribution round-trips (ref DistributedArray.py:463-522
+    pairwise sendrecv -> resharding collective), including ragged."""
+    x = rng.standard_normal(shape)
+    dx = DistributedArray.to_dist(x, axis=ax_from)
+    dy = dx.redistribute(ax_to)
+    assert dy.axis == ax_to
+    np.testing.assert_allclose(dy.asarray(), x, rtol=1e-14)
+    dz = dy.redistribute(ax_from)
+    np.testing.assert_allclose(dz.asarray(), x, rtol=1e-14)
+
+
+def test_add_ghost_cells_widths(rng):
+    """Ghost widths 1 and 2, both directions, against hand-built
+    windows (ref DistributedArray.py:877-954)."""
+    x = rng.standard_normal((16, 3))
+    dx = DistributedArray.to_dist(x, axis=0)
+    sizes = [s[0] for s in dx.local_shapes]
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    for front, back in ((1, 1), (2, 0), (0, 2), (2, 2)):
+        ghosts = dx.add_ghost_cells(cells_front=front, cells_back=back)
+        for i, g in enumerate(ghosts):
+            lo = max(0, offs[i] - (front if i > 0 else 0))
+            hi = min(16, offs[i + 1] + (back if i < 7 else 0))
+            np.testing.assert_allclose(np.asarray(g), x[lo:hi], rtol=1e-14)
+
+
+def test_add_ghost_cells_too_wide(rng):
+    dx = DistributedArray.to_dist(rng.standard_normal(16))  # 2 rows/shard
+    with pytest.raises(ValueError, match="ghost"):
+        dx.add_ghost_cells(cells_front=3)
+
+
+def test_to_partition_roundtrip(rng):
+    x = rng.standard_normal(24)
+    dx = DistributedArray.to_dist(x)
+    db = dx.to_partition(Partition.BROADCAST)
+    assert db.partition == Partition.BROADCAST
+    np.testing.assert_allclose(db.asarray(), x, rtol=1e-14)
+    ds = db.to_partition(Partition.SCATTER)
+    assert ds.partition == Partition.SCATTER
+    np.testing.assert_allclose(ds.asarray(), x, rtol=1e-14)
+
+
+def test_conj_and_complex_arith(rng):
+    x = rng.standard_normal(24) + 1j * rng.standard_normal(24)
+    dx = DistributedArray.to_dist(x)
+    np.testing.assert_allclose(dx.conj().asarray(), x.conj(), rtol=1e-14)
+    np.testing.assert_allclose((dx * (1 - 2j)).asarray(), x * (1 - 2j),
+                               rtol=1e-14)
+    np.testing.assert_allclose(float(dx.norm(2)), np.linalg.norm(x),
+                               rtol=1e-12)
+    # vdot conjugates the left operand
+    y = rng.standard_normal(24) + 1j * rng.standard_normal(24)
+    dy = DistributedArray.to_dist(y)
+    np.testing.assert_allclose(complex(dx.dot(dy, vdot=True)),
+                               np.vdot(x, y), rtol=1e-12)
+
+
+def test_dtype_promotion(rng):
+    xf = DistributedArray.to_dist(rng.standard_normal(16).astype(np.float32))
+    xc = DistributedArray.to_dist(
+        (rng.standard_normal(16) + 1j * rng.standard_normal(16)
+         ).astype(np.complex64))
+    assert (xf + xc).dtype == np.complex64
+    assert (xf * 2.0).asarray().dtype == np.float32
+
+
+def test_partition_mismatch_raises(rng):
+    a = DistributedArray.to_dist(rng.standard_normal(16))
+    b = DistributedArray.to_dist(rng.standard_normal(16),
+                                 partition=Partition.BROADCAST)
+    with pytest.raises(ValueError, match="Partition mismatch"):
+        a + b
+
+
+def test_global_shape_mismatch_raises(rng):
+    a = DistributedArray.to_dist(rng.standard_normal(16))
+    b = DistributedArray.to_dist(rng.standard_normal(17))
+    with pytest.raises(ValueError, match="shape mismatch"):
+        a + b
+
+
+def test_custom_local_shapes_validation(rng):
+    with pytest.raises(ValueError, match="sum to"):
+        DistributedArray((16,), local_shapes=[(3,)] * 8)  # 24 != 16
+    with pytest.raises(ValueError, match="local shapes"):
+        DistributedArray((16,), local_shapes=[(4,)] * 4)  # wrong count
+
+
+def test_masked_norm_ords(rng):
+    """Per-group norms for every order (ref subcomm reductions)."""
+    mask = [0, 0, 1, 1, 2, 2, 3, 3]
+    x = rng.standard_normal(32)
+    dx = DistributedArray.to_dist(x, mask=mask)
+    for ordd in (1, 2, np.inf):
+        got = np.asarray(dx.norm(ordd))
+        expected = [np.linalg.norm(x[i * 8:(i + 1) * 8], ordd)
+                    for i in range(4)]
+        np.testing.assert_allclose(got, expected, rtol=1e-10)
+
+
+def test_ravel_axis1(rng):
+    """Shard-major ravel of an axis-1-sharded array is the shard-block
+    concatenation, not the global C-ravel (ref DistributedArray.py:847-875)."""
+    x = rng.standard_normal((4, 16))
+    dx = DistributedArray.to_dist(x, axis=1)
+    flat = dx.ravel()
+    expected = np.concatenate(
+        [x[:, 2 * i:2 * (i + 1)].ravel() for i in range(8)])
+    np.testing.assert_allclose(flat.asarray(), expected, rtol=1e-14)

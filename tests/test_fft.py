@@ -207,3 +207,68 @@ def test_fftnd_axes_ending_in_zero(rng):
         rng.standard_normal(Fop.shape[0])
         + 1j * rng.standard_normal(Fop.shape[0]))
     dottest(Fop, u, v)
+
+
+def test_fft2d_real_odd(rng):
+    """2-D real FFT on mesh-indivisible dims."""
+    dims = (15, 11)
+    Fop = MPIFFT2D(dims, real=True, dtype=np.float64)
+    assert Fop.dimsd_nd == (15, 6)
+    x = rng.standard_normal(dims)
+    dx = DistributedArray.to_dist(x.ravel())
+    got = Fop.matvec(dx).asarray().reshape(15, 6)
+    expected = np.fft.rfftn(x, axes=(0, 1))
+    expected[:, 1:1 + (11 - 1) // 2] *= np.sqrt(2)
+    np.testing.assert_allclose(got, expected, rtol=1e-10, atol=1e-10)
+    back = Fop.rmatvec(Fop.matvec(dx))
+    # norm=none roundtrip: rmatvec(matvec(x)) ~ N x for real FFTs up to
+    # the sqrt2-scaling making it an isometry on the half-spectrum
+    assert back.global_shape == (np.prod(dims),)
+
+
+def test_fftnd_norm_1n_odd_roundtrip(rng):
+    dims = (9, 7)
+    Fop = MPIFFTND(dims, axes=(0, 1), norm="1/n", dtype=np.complex128)
+    x = rng.standard_normal(np.prod(dims)) + 1j * rng.standard_normal(
+        np.prod(dims))
+    dx = DistributedArray.to_dist(x)
+    back = Fop.rmatvec(Fop.matvec(dx)).asarray()
+    np.testing.assert_allclose(back, x / np.prod(dims), rtol=1e-10,
+                               atol=1e-12)
+
+
+def test_fftnd_nfft_larger_than_dims_odd(rng):
+    """Zero-padding transforms (nfft > dims) on ragged pencils."""
+    dims = (9, 6)
+    Fop = MPIFFTND(dims, axes=(0, 1), nffts=(13, 10), dtype=np.complex128)
+    assert Fop.dimsd_nd == (13, 10)
+    x = rng.standard_normal(dims) + 1j * rng.standard_normal(dims)
+    dx = DistributedArray.to_dist(x.ravel())
+    got = Fop.matvec(dx).asarray().reshape(13, 10)
+    np.testing.assert_allclose(got, np.fft.fftn(x, s=(13, 10)),
+                               rtol=1e-10, atol=1e-10)
+    u = DistributedArray.to_dist(
+        rng.standard_normal(54) + 1j * rng.standard_normal(54))
+    v = DistributedArray.to_dist(
+        rng.standard_normal(130) + 1j * rng.standard_normal(130))
+    dottest(Fop, u, v)
+
+
+def test_fftnd_aligned_output_feeds_aligned_input(rng):
+    """matvec output carries data_local_shapes; feeding it to rmatvec
+    re-enters with a pure reshape — verified via round-trip parity with
+    the misaligned path."""
+    dims = (17, 13)
+    Fop = MPIFFTND(dims, axes=(0, 1), dtype=np.complex128)
+    n = int(np.prod(dims))
+    x = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    aligned = DistributedArray.to_dist(x,
+                                       local_shapes=Fop.model_local_shapes)
+    default = DistributedArray.to_dist(x)
+    ya = Fop.matvec(aligned)
+    yd = Fop.matvec(default)
+    assert tuple(ya.local_shapes) == tuple(Fop.data_local_shapes)
+    np.testing.assert_allclose(ya.asarray(), yd.asarray(), rtol=1e-12)
+    za = Fop.rmatvec(ya)
+    np.testing.assert_allclose(za.asarray(), Fop.rmatvec(yd).asarray(),
+                               rtol=1e-12)

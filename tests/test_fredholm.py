@@ -120,3 +120,54 @@ def test_mdc_inversion(rng):
                                   partition=Partition.BROADCAST)
     x, *_ = cgls(Op, dy, x0, niter=300, tol=1e-14)
     np.testing.assert_allclose(x.asarray(), xtrue, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("cmplx", [False, True])
+@pytest.mark.parametrize("usematmul", [True, False])
+def test_fredholm1_adjoint_oracle(rng, cmplx, usematmul):
+    """Adjoint against the dense batched G^H y oracle + dottest
+    (ref tests/test_fredholm.py dtype parametrization)."""
+    nsl, nx, ny, nz = 8, 5, 4, 3
+    dt = np.complex128 if cmplx else np.float64
+    G = rng.standard_normal((nsl, nx, ny))
+    if cmplx:
+        G = G + 1j * rng.standard_normal((nsl, nx, ny))
+    G = G.astype(dt)
+    Fr = MPIFredholm1(G, nz=nz, dtype=dt)
+    y = rng.standard_normal((nsl, nx, nz))
+    if cmplx:
+        y = y + 1j * rng.standard_normal((nsl, nx, nz))
+    dy = DistributedArray.to_dist(y.ravel().astype(dt),
+                                  partition=Partition.BROADCAST)
+    got = Fr.rmatvec(dy).asarray().reshape(nsl, ny, nz)
+    expected = np.einsum("sxy,sxz->syz", G.conj(), y)
+    np.testing.assert_allclose(got, expected, rtol=1e-10, atol=1e-11)
+    u = DistributedArray.to_dist(
+        (rng.standard_normal(Fr.shape[1])
+         + (1j * rng.standard_normal(Fr.shape[1]) if cmplx else 0)
+         ).astype(dt), partition=Partition.BROADCAST)
+    v = DistributedArray.to_dist(
+        (rng.standard_normal(Fr.shape[0])
+         + (1j * rng.standard_normal(Fr.shape[0]) if cmplx else 0)
+         ).astype(dt), partition=Partition.BROADCAST)
+    yv = np.vdot(Fr.matvec(u).asarray(), v.asarray())
+    ux = np.vdot(u.asarray(), Fr.rmatvec(v).asarray())
+    np.testing.assert_allclose(yv, ux, rtol=1e-10)
+
+
+def test_fredholm1_cgls_inversion(rng):
+    """Frequency-sharded least-squares inversion through Fredholm1
+    (the MDD core problem, ref tutorials/mdd.py)."""
+    nsl, nx, ny, nz = 8, 8, 4, 2
+    G = rng.standard_normal((nsl, nx, ny))
+    Fr = MPIFredholm1(G, nz=nz, dtype=np.float64)
+    mtrue = rng.standard_normal((nsl, ny, nz))
+    y = np.einsum("sxy,syz->sxz", G, mtrue)
+    dy = DistributedArray.to_dist(y.ravel(),
+                                  partition=Partition.BROADCAST)
+    from pylops_mpi_tpu import cgls
+    x0 = DistributedArray.to_dist(np.zeros(nsl * ny * nz),
+                                  partition=Partition.BROADCAST)
+    m, *_ = cgls(Fr, dy, x0, niter=300, tol=1e-14)
+    np.testing.assert_allclose(m.asarray().reshape(nsl, ny, nz), mtrue,
+                               rtol=1e-5, atol=1e-7)

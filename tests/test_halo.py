@@ -180,3 +180,75 @@ def test_distributed_nonstatconv(rng):
     np.testing.assert_allclose(Op.rmatvec(dy).asarray(),
                                np.asarray(serial.rmatvec(dy.asarray())),
                                rtol=1e-10)
+
+
+def test_halo_3d_grid(rng):
+    """3-D Cartesian process grid (2x2x2): forward pads every axis with
+    neighbour slabs, corners relayed axis-by-axis; adjoint crops back to
+    the exact input (ref Halo.py:320-423)."""
+    dims = (4, 6, 8)
+    grid = (2, 2, 2)
+    x = rng.standard_normal(dims)
+    flat, sizes = _block_flat(x, grid)
+    Hop = MPIHalo(dims=dims, halo=1, proc_grid_shape=grid, dtype=np.float64)
+    dx = DistributedArray.to_dist(flat, local_shapes=sizes)
+    y = Hop.matvec(dx)
+    locs = y.local_arrays()
+    for r in range(8):
+        sl = halo_block_split(dims, r, grid)
+        coords = np.unravel_index(r, grid)
+        lohi = []
+        for ax in range(3):
+            lo = sl[ax].start - (1 if coords[ax] > 0 else 0)
+            hi = sl[ax].stop + (1 if coords[ax] < grid[ax] - 1 else 0)
+            lohi.append((lo, hi))
+        expected = x[lohi[0][0]:lohi[0][1], lohi[1][0]:lohi[1][1],
+                     lohi[2][0]:lohi[2][1]]
+        np.testing.assert_allclose(locs[r].reshape(expected.shape),
+                                   expected, rtol=1e-12)
+    # adjoint crops the halo back: left-inverse identity, as in the
+    # reference (Halo.py:400-423 — crop, not a summing transpose)
+    z = Hop.rmatvec(y)
+    np.testing.assert_allclose(z.asarray(), flat, rtol=1e-12)
+
+
+def test_halo_3d_hlo_neighbor_exchange(rng):
+    """3-D halo lowering is still boundary-slab collective-permutes."""
+    import jax
+
+    dims, grid = (4, 4, 4), (2, 2, 2)
+    x = rng.standard_normal(dims)
+    flat, sizes = _block_flat(x, grid)
+    Hop = MPIHalo(dims=dims, halo=1, proc_grid_shape=grid,
+                  dtype=np.float64)
+    dx = DistributedArray.to_dist(flat, local_shapes=sizes)
+    txt = jax.jit(lambda d: Hop.matvec(d)._arr).lower(
+        dx).compile().as_text().lower()
+    assert "collective-permute" in txt or "collective_permute" in txt
+    assert "all-gather" not in txt and "all_gather" not in txt
+
+
+@pytest.mark.parametrize("nh,nfilt", [(3, 16), (7, 16)])
+def test_distributed_nonstatconv_sweep(rng, nh, nfilt):
+    """Distributed non-stationary convolution vs the local oracle for
+    several filter banks (ref NonStatConvolve1d.py:119-188: halo width
+    from filter spacing, one-filter overlap at shard edges)."""
+    from pylops_mpi_tpu.ops.nonstatconv import MPINonStationaryConvolve1D
+    from pylops_mpi_tpu.ops.local import NonStationaryConvolve1D as LocalNSC
+    import jax.numpy as jnp
+
+    n = 64
+    hs = rng.standard_normal((nfilt, nh))
+    # regular spacing with filters inside every shard and a halo width
+    # the one-hop neighbour exchange supports
+    ih = tuple(range(2, n, n // nfilt))
+    Op = MPINonStationaryConvolve1D((n,), hs, ih, dtype=np.float64)
+    local = LocalNSC((n,), hs, ih, dtype=np.float64)
+    x = rng.standard_normal(n)
+    dx = DistributedArray.to_dist(x)
+    np.testing.assert_allclose(
+        Op.matvec(dx).asarray(),
+        np.asarray(local._matvec(jnp.asarray(x))), rtol=1e-11, atol=1e-11)
+    np.testing.assert_allclose(
+        Op.rmatvec(dx).asarray(),
+        np.asarray(local._rmatvec(jnp.asarray(x))), rtol=1e-11, atol=1e-11)

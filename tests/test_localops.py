@@ -131,3 +131,122 @@ def test_local_algebra(rng):
                                2 * A @ x + A.T @ x)
     np.testing.assert_allclose(np.asarray((op @ op).matvec(x)), A @ (A @ x))
     np.testing.assert_allclose(op.todense(), A)
+
+
+def _dense_of(op):
+    """Dense matrix of a local operator via unit vectors."""
+    n = op.shape[1]
+    cols = [np.asarray(op._matvec(jnp.asarray(
+        np.eye(n, dtype=np.float64)[:, i]))) for i in range(n)]
+    return np.stack(cols, axis=1)
+
+
+@pytest.mark.parametrize("opname,kwargs,dims", [
+    ("Diagonal", {}, (12,)),
+    ("Roll", {"shift": 3}, (10,)),
+    ("Flip", {}, (9,)),
+    ("Transpose", {"axes": (1, 0)}, (4, 6)),
+])
+def test_local_op_adjoints(rng, opname, kwargs, dims):
+    """Every local operator family member satisfies the adjoint identity
+    and matches its dense matrix (the pylops base-op contract)."""
+    from pylops_mpi_tpu.ops import local as L
+    n = int(np.prod(dims))
+    if opname == "Diagonal":
+        op = L.Diagonal(rng.standard_normal(n), dtype=np.float64)
+    elif opname == "Roll":
+        op = L.Roll(dims, dtype=np.float64, **kwargs)
+    elif opname == "Flip":
+        op = L.Flip(dims, dtype=np.float64)
+    else:
+        op = L.Transpose(dims, dtype=np.float64, **kwargs)
+    D = _dense_of(op)
+    x = rng.standard_normal(op.shape[1])
+    y = rng.standard_normal(op.shape[0])
+    np.testing.assert_allclose(np.asarray(op._matvec(jnp.asarray(x))),
+                               D @ x, rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(op._rmatvec(jnp.asarray(y))),
+                               D.T @ y, rtol=1e-12, atol=1e-12)
+
+
+def test_local_zero_and_function(rng):
+    from pylops_mpi_tpu.ops import local as L
+    z = L.Zero(6, 4, dtype=np.float64)
+    np.testing.assert_allclose(
+        np.asarray(z._matvec(jnp.asarray(rng.standard_normal(4)))), 0.0)
+    np.testing.assert_allclose(
+        np.asarray(z._rmatvec(jnp.asarray(rng.standard_normal(6)))), 0.0)
+    f = L.FunctionOperator(lambda v: 2 * v, lambda v: 2 * v, 5,
+                           dtype=np.float64)
+    x = rng.standard_normal(5)
+    np.testing.assert_allclose(np.asarray(f._matvec(jnp.asarray(x))),
+                               2 * x, rtol=1e-12)
+
+
+def test_local_pad_adjoint(rng):
+    from pylops_mpi_tpu.ops import local as L
+    op = L.Pad((6,), ((2, 3),), dtype=np.float64)
+    D = _dense_of(op)
+    x = rng.standard_normal(6)
+    got = np.asarray(op._matvec(jnp.asarray(x)))
+    np.testing.assert_allclose(got, np.pad(x, (2, 3)), rtol=1e-12)
+    y = rng.standard_normal(11)
+    np.testing.assert_allclose(np.asarray(op._rmatvec(jnp.asarray(y))),
+                               D.T @ y, rtol=1e-12)
+
+
+def test_local_blockdiag_hstack_vstack_oracle(rng):
+    from pylops_mpi_tpu.ops import local as L
+    A = rng.standard_normal((3, 4))
+    B = rng.standard_normal((2, 5))
+    bd = L.BlockDiag([L.MatrixMult(A, dtype=np.float64),
+                      L.MatrixMult(B, dtype=np.float64)])
+    import scipy.linalg as spla
+    D = spla.block_diag(A, B)
+    x = rng.standard_normal(9)
+    np.testing.assert_allclose(np.asarray(bd._matvec(jnp.asarray(x))),
+                               D @ x, rtol=1e-12)
+    vs = L.VStack([L.MatrixMult(A, dtype=np.float64),
+                   L.MatrixMult(rng.standard_normal((2, 4)),
+                                dtype=np.float64)])
+    assert vs.shape == (5, 4)
+    hs = L.HStack([L.MatrixMult(A, dtype=np.float64),
+                   L.MatrixMult(rng.standard_normal((3, 2)),
+                                dtype=np.float64)])
+    assert hs.shape == (3, 6)
+    xh = rng.standard_normal(6)
+    Dh = np.hstack([A, np.asarray(hs.ops[1].A)])
+    np.testing.assert_allclose(np.asarray(hs._matvec(jnp.asarray(xh))),
+                               Dh @ xh, rtol=1e-12)
+
+
+def test_local_fft_norms(rng):
+    """Local FFT norm modes against numpy (pylops FFT semantics)."""
+    from pylops_mpi_tpu.ops import local as L
+    n = 16
+    x = rng.standard_normal(n)
+    for real in (False, True):
+        op = L.FFT((n,), real=real, dtype=np.float64 if real
+                   else np.complex128)
+        got = np.asarray(op._matvec(jnp.asarray(
+            x.astype(np.complex128) if not real else x)))
+        if real:
+            expected = np.fft.rfft(x) / np.sqrt(n)
+            expected[1:1 + (n - 1) // 2] *= np.sqrt(2)
+            np.testing.assert_allclose(got, expected, rtol=1e-10,
+                                       atol=1e-12)
+        else:
+            np.testing.assert_allclose(got, np.fft.fft(x) / np.sqrt(n),
+                                       rtol=1e-10, atol=1e-12)
+
+
+def test_local_nonstat_conv_adjoint(rng):
+    from pylops_mpi_tpu.ops import local as L
+    n, nh = 24, 5
+    hs = rng.standard_normal((3, nh))
+    ih = (4, 12, 20)
+    op = L.NonStationaryConvolve1D((n,), hs, ih, dtype=np.float64)
+    D = _dense_of(op)
+    y = rng.standard_normal(n)
+    np.testing.assert_allclose(np.asarray(op._rmatvec(jnp.asarray(y))),
+                               D.T @ y, rtol=1e-11, atol=1e-11)

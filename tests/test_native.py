@@ -174,3 +174,49 @@ def test_unpack_padded_rejects_bad_shape(rng):
         native.unpack_padded(x, 1, [4, 3, 3], 5)  # 3*5 != 12
     with pytest.raises(ValueError, match="s_phys"):
         native.unpack_padded(x, 1, [4, 5, 3], 4)  # size 5 > s_phys 4
+
+
+def test_write_binary_at_streaming(tmp_path, rng):
+    """Streaming several arrays into one file at offsets reassembles
+    exactly (checkpoint-writer primitive)."""
+    a = rng.standard_normal(64).astype(np.float32)
+    b = rng.standard_normal(32).astype(np.float32)
+    path = str(tmp_path / "stream.bin")
+    native.write_binary_at(path, 0, a)
+    native.write_binary_at(path, a.nbytes, b)
+    back_a = native.read_binary(path, np.float32, (64,))
+    back_b = native.read_binary(path, np.float32, (32,), offset=a.nbytes)
+    np.testing.assert_array_equal(back_a, a)
+    np.testing.assert_array_equal(back_b, b)
+
+
+def test_read_binary_short_read_raises(tmp_path):
+    path = str(tmp_path / "short.bin")
+    np.zeros(4, dtype=np.float64).tofile(path)
+    with pytest.raises(IOError):
+        native.read_binary(path, np.float64, (100,))
+
+
+def test_pack_unpack_3d_axis_middle(rng):
+    """Padded pack/unpack round-trip on a middle axis with a ragged
+    split (the layout DistributedArray uses for axis != 0)."""
+    x = rng.standard_normal((3, 13, 5))
+    sizes = native.local_split_native(13, 8)
+    s_phys = int(sizes.max())
+    packed = native.pack_padded(x, 1, sizes, s_phys)
+    assert packed.shape == (3, 8 * s_phys, 5)
+    back = native.unpack_padded(packed, 1, sizes, s_phys)
+    np.testing.assert_array_equal(back, x)
+    # padding regions are zero-filled
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    for p in range(8):
+        pad = packed[:, p * s_phys + int(sizes[p]):(p + 1) * s_phys]
+        np.testing.assert_array_equal(pad, 0)
+
+
+def test_local_split_native_matches_python():
+    from pylops_mpi_tpu.parallel.partition import Partition, local_split
+    for n, p in ((17, 8), (64, 8), (3, 8), (100, 7)):
+        nat = native.local_split_native(n, p)
+        ref = [s[0] for s in local_split((n,), p, Partition.SCATTER, 0)]
+        np.testing.assert_array_equal(nat, ref)

@@ -173,3 +173,142 @@ def test_power_iteration_fused_matches_eager(rng):
     assert itf == ite
     np.testing.assert_allclose(ef, ee, rtol=1e-10)
     np.testing.assert_allclose(bf.asarray(), be.asarray(), rtol=1e-8)
+
+
+# --------------------------------------------- reference sparsity matrix
+# (ref tests/test_sparsity.py, 331 LoC: solver x threshold x operator
+#  parametrization against NumPy reference iterations)
+
+def _np_ista(A, y, eps, niter, alpha, threshkind="soft"):
+    """Independent NumPy ISTA (prox-gradient) oracle."""
+    x = np.zeros(A.shape[1])
+    thresh = eps * alpha * 0.5
+    for _ in range(niter):
+        g = x + alpha * (A.T @ (y - A @ x))
+        if threshkind == "soft":
+            x = np.sign(g) * np.maximum(np.abs(g) - thresh, 0.0)
+        else:  # hard
+            x = np.where(np.abs(g) ** 2 > 2 * thresh, g, 0.0)
+    return x
+
+
+def _np_fista(A, y, eps, niter, alpha):
+    x = np.zeros(A.shape[1])
+    z = x.copy()
+    t = 1.0
+    thresh = eps * alpha * 0.5
+    for _ in range(niter):
+        g = z + alpha * (A.T @ (y - A @ z))
+        xnew = np.sign(g) * np.maximum(np.abs(g) - thresh, 0.0)
+        tnew = (1 + np.sqrt(1 + 4 * t ** 2)) / 2
+        z = xnew + ((t - 1) / tnew) * (xnew - x)
+        x, t = xnew, tnew
+    return x
+
+
+def _bd_problem(rng, bm, bn, nblk=8):
+    mats = [rng.standard_normal((bm, bn)) / np.sqrt(bm) for _ in range(nblk)]
+    Op = MPIBlockDiag([MatrixMult(m, dtype=np.float64) for m in mats])
+    import scipy.linalg as spla
+    return Op, spla.block_diag(*mats)
+
+
+@pytest.mark.parametrize("threshkind", ["soft", "hard"])
+@pytest.mark.parametrize("fused", [True, False])
+def test_ista_vs_numpy_oracle(rng, threshkind, fused):
+    """Fixed step size + fixed iterations: distributed ISTA must track
+    the NumPy recurrence exactly (same alpha, no decay)."""
+    Op, dense = _bd_problem(rng, 6, 4)
+    xtrue = np.zeros(32)
+    xtrue[[3, 11, 20, 29]] = [2.0, -3.0, 1.5, -1.0]
+    y = dense @ xtrue
+    eps, alpha, niter = 0.1, 0.25, 30
+    dy = DistributedArray.to_dist(y)
+    x0 = DistributedArray.to_dist(np.zeros(32))
+    x, niters, cost = ista(Op, dy, x0, niter=niter, eps=eps, alpha=alpha,
+                           threshkind=threshkind, fused=fused, tol=0.0)
+    expected = _np_ista(dense, y, eps, niter, alpha, threshkind)
+    np.testing.assert_allclose(x.asarray(), expected, rtol=1e-9, atol=1e-11)
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_fista_vs_numpy_oracle(rng, fused):
+    Op, dense = _bd_problem(rng, 6, 4)
+    xtrue = np.zeros(32)
+    xtrue[[1, 9, 17, 30]] = [1.0, -2.0, 3.0, -1.5]
+    y = dense @ xtrue
+    eps, alpha, niter = 0.05, 0.25, 40
+    dy = DistributedArray.to_dist(y)
+    x0 = DistributedArray.to_dist(np.zeros(32))
+    x, niters, cost = fista(Op, dy, x0, niter=niter, eps=eps, alpha=alpha,
+                            fused=fused, tol=0.0)
+    expected = _np_fista(dense, y, eps, niter, alpha)
+    np.testing.assert_allclose(x.asarray(), expected, rtol=1e-9, atol=1e-11)
+
+
+def test_ista_auto_alpha_converges(rng):
+    """alpha=None: 1/lambda_max step from power iteration on Op^H Op
+    (ref cls_sparsity.py:239-255) must converge to the sparse truth."""
+    Op, dense = _bd_problem(rng, 12, 4)
+    xtrue = np.zeros(32)
+    xtrue[[2, 13, 27]] = [3.0, -2.0, 2.5]
+    y = dense @ xtrue
+    dy = DistributedArray.to_dist(y)
+    x0 = DistributedArray.to_dist(np.zeros(32))
+    x, *_ = ista(Op, dy, x0, niter=400, eps=0.02, tol=0.0)
+    got = x.asarray()
+    # support recovery + approximate amplitude
+    assert set(np.flatnonzero(np.abs(got) > 0.5)) == {2, 13, 27}
+    np.testing.assert_allclose(got[[2, 13, 27]], xtrue[[2, 13, 27]],
+                               rtol=0.2)
+
+
+def test_fista_momentum_beats_ista(rng):
+    """FISTA's Nesterov momentum converges no slower than ISTA on the
+    same problem (cost at matched iteration count)."""
+    Op, dense = _bd_problem(rng, 8, 4)
+    xtrue = np.zeros(32)
+    xtrue[[5, 19]] = [2.0, -2.0]
+    y = dense @ xtrue
+    dy = DistributedArray.to_dist(y)
+    x0 = DistributedArray.to_dist(np.zeros(32))
+    niter, eps, alpha = 60, 0.05, 0.25
+    _, _, cost_i = ista(Op, dy, x0, niter=niter, eps=eps, alpha=alpha,
+                        tol=0.0)
+    _, _, cost_f = fista(Op, dy, x0, niter=niter, eps=eps, alpha=alpha,
+                         tol=0.0)
+    assert cost_f[-1] <= cost_i[-1] * 1.05
+
+
+def test_ista_complex(rng):
+    """Complex operator/data: soft threshold acts on magnitudes
+    (ref _softthreshold complex branch)."""
+    mats = [(rng.standard_normal((6, 4)) + 1j * rng.standard_normal((6, 4)))
+            / np.sqrt(12) for _ in range(8)]
+    Op = MPIBlockDiag([MatrixMult(m, dtype=np.complex128) for m in mats])
+    xtrue = np.zeros(32, dtype=np.complex128)
+    xtrue[[4, 22]] = [2.0 + 1.0j, -1.5 + 0.5j]
+    import scipy.linalg as spla
+    dense = spla.block_diag(*mats)
+    y = dense @ xtrue
+    dy = DistributedArray.to_dist(y)
+    x0 = DistributedArray.to_dist(np.zeros(32, dtype=np.complex128))
+    x, *_ = ista(Op, dy, x0, niter=300, eps=0.02, alpha=0.25, tol=0.0)
+    got = x.asarray()
+    assert set(np.flatnonzero(np.abs(got) > 0.3)) == {4, 22}
+
+
+def test_ista_half_threshold(rng):
+    """half-thresholding variant runs and sparsifies (ref
+    _halfthreshold, cls_sparsity.py:21-46)."""
+    Op, dense = _bd_problem(rng, 8, 4)
+    xtrue = np.zeros(32)
+    xtrue[[7, 25]] = [3.0, -3.0]
+    y = dense @ xtrue
+    dy = DistributedArray.to_dist(y)
+    x0 = DistributedArray.to_dist(np.zeros(32))
+    x, *_ = ista(Op, dy, x0, niter=200, eps=0.05, alpha=0.25,
+                 threshkind="half", tol=0.0)
+    got = x.asarray()
+    assert np.sum(np.abs(got) > 0.3) <= 6
+    assert {7, 25} <= set(np.flatnonzero(np.abs(got) > 0.3))

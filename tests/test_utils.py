@@ -113,3 +113,76 @@ def test_solver_checkpoint_wrong_class(tmp_path, rng):
     from pylops_mpi_tpu import CG
     with pytest.raises(ValueError, match="checkpoint is for"):
         load_solver(path, CG(Op))
+
+
+def test_benchmark_nested_tree_structure(capsys):
+    """Nested decorated calls render as an indented span tree with
+    per-segment percentages."""
+    from pylops_mpi_tpu.utils import benchmark, mark
+
+    @benchmark(description="inner")
+    def inner():
+        mark("mid")
+        return 1
+
+    @benchmark(description="outer")
+    def outer():
+        mark("before-inner")
+        return inner()
+
+    assert outer() == 1
+    out = capsys.readouterr().out
+    lines = out.splitlines()
+    assert lines[0].startswith("[outer] total")
+    assert any(l.startswith("  start => before-inner:") for l in lines)
+    # child span indented under the parent
+    assert any(l.startswith("  [inner] total") for l in lines)
+    assert any("(100.0%)" in l or "%" in l for l in lines)
+
+
+def test_benchmark_logger_sink(capsys):
+    import logging
+    from pylops_mpi_tpu.utils import benchmark
+    records = []
+    logger = logging.getLogger("bench-test")
+    logger.setLevel(logging.INFO)
+    h = logging.Handler()
+    h.emit = lambda r: records.append(r.getMessage())
+    logger.addHandler(h)
+
+    @benchmark(description="logged", logger=logger)
+    def work():
+        return 5
+
+    assert work() == 5
+    assert capsys.readouterr().out == ""  # logger, not stdout
+    assert any("[logged] total" in m for m in records)
+
+
+def test_solver_checkpoint_cgls_fresh_process_shape(tmp_path, rng):
+    """Checkpoint restores iteration counter AND solver scalars so the
+    resumed trajectory is identical, also for ragged problems."""
+    sizes = [3, 5, 2, 4, 3, 5, 2, 4]
+    mats = []
+    for s in sizes:
+        a = rng.standard_normal((s, s))
+        mats.append(a @ a.T + s * np.eye(s))
+    Op = MPIBlockDiag([MatrixMult(m, dtype=np.float64) for m in mats])
+    n = sum(sizes)
+    y = DistributedArray.to_dist(rng.standard_normal(n),
+                                 local_shapes=Op.local_shapes_n)
+    ref = CGLS(Op)
+    xr = ref.setup(y, y.zeros_like(), niter=16, tol=0)
+    xr = ref.run(xr, 16)
+
+    s1 = CGLS(Op)
+    x = s1.setup(y, y.zeros_like(), niter=16, tol=0)
+    for _ in range(5):
+        x = s1.step(x)
+    path = str(tmp_path / "ragged.ckpt")
+    save_solver(path, s1, x=x)
+    s2 = CGLS(Op)
+    x2 = load_solver(path, s2)
+    while s2.iiter < 16:
+        x2 = s2.step(x2)
+    np.testing.assert_allclose(x2.asarray(), xr.asarray(), rtol=1e-9)
