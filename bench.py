@@ -127,27 +127,52 @@ def child_main():
     x0 = pmt.DistributedArray.to_dist(np.zeros_like(xtrue), mesh=mesh)
 
     def measure(bf16: bool, fused_normal: bool):
-        """Best-of-5 timed solve; returns (iters/s, GFLOP/s, rel_err)."""
+        """Marginal-cost timing: solves of ``niter`` and ``3*niter``
+        iterations, per-iteration time = slope between them. This
+        cancels the per-dispatch overhead of the remote-TPU tunnel,
+        which fluctuates between ~0.1 ms and tens of ms run to run
+        (observed round 2) and would otherwise dominate the number.
+        Returns (iters/s, GFLOP/s, GB/s, rel_err)."""
         Op = pmt.MPIBlockDiag(
             [MatrixMult(b, dtype=np.float32) for b in blocks_np],
             compute_dtype=jnp.bfloat16 if bf16 else None)
-        solver = _cgls_fused_normal if (fused_normal and Op.has_fused_normal) \
-            else _cgls_fused
-        fn = jax.jit(lambda y, x, damp, tol: solver(Op, y, x, niter,
-                                                    damp, tol))
-        out = fn(dy, x0, 0.0, 0.0)
-        jax.block_until_ready(out[0]._arr)
-        dt = float("inf")
-        for _ in range(5):
-            t0 = time.perf_counter()
+        use_normal = fused_normal and Op.has_fused_normal
+        solver = _cgls_fused_normal if use_normal else _cgls_fused
+
+        def timed(nit):
+            fn = jax.jit(lambda y, x, damp, tol: solver(Op, y, x, nit,
+                                                        damp, tol))
             out = fn(dy, x0, 0.0, 0.0)
             jax.block_until_ready(out[0]._arr)
-            dt = min(dt, time.perf_counter() - t0)
+            dt = float("inf")
+            for _ in range(7):
+                t0 = time.perf_counter()
+                out = fn(dy, x0, 0.0, 0.0)
+                jax.block_until_ready(out[0]._arr)
+                dt = min(dt, time.perf_counter() - t0)
+            return dt, out
+
+        t1, out = timed(niter)
+        t3, _ = timed(3 * niter)
+        per_iter = (t3 - t1) / (2 * niter)
+        if per_iter <= 0:
+            # tunnel noise swamped the slope: retry once, then fall
+            # back to absolute timing rather than reporting a bogus
+            # near-infinite rate
+            t1, out = timed(niter)
+            t3, _ = timed(3 * niter)
+            per_iter = (t3 - t1) / (2 * niter)
+            if per_iter <= 0:
+                per_iter = t3 / (3 * niter)
         # 2 GEMMs (matvec+rmatvec) per iteration, 2*N^2 flops each/block
-        gflops = (4.0 * nblock * nblock * nblk * niter / dt) / 1e9
+        gflops = (4.0 * nblock * nblock * nblk / per_iter) / 1e9
+        # one (fused-normal) or two (classic) sweeps of the blocks/iter
+        itemsize = 2 if bf16 else 4
+        sweeps = 1 if use_normal else 2
+        gbps = (sweeps * nblock * nblock * nblk * itemsize / per_iter) / 1e9
         rel_err = float(np.linalg.norm(out[0].asarray() - xtrue)
                         / np.linalg.norm(xtrue))
-        return niter / dt, gflops, rel_err
+        return 1.0 / per_iter, gflops, gbps, rel_err
 
     # bf16 block storage (the native TPU matrix format) halves HBM
     # traffic of the memory-bound matvec; MXU accumulates in f32. The
@@ -155,12 +180,13 @@ def child_main():
     # baseline comparison. BENCH_F32_PYLOPS_MPI_TPU=1 makes f32 primary.
     want_bf16 = on_tpu and os.environ.get("BENCH_F32_PYLOPS_MPI_TPU",
                                           "0") != "1"
-    f32_ips, f32_gflops, f32_err = measure(bf16=False, fused_normal=False)
+    f32_ips, f32_gflops, f32_gbps, f32_err = measure(bf16=False,
+                                                     fused_normal=False)
     if want_bf16:
-        ips, gflops, rel_err = measure(bf16=True, fused_normal=True)
+        ips, gflops, gbps, rel_err = measure(bf16=True, fused_normal=True)
         mode = "bf16-storage fused-normal"
     else:
-        ips, gflops, rel_err = f32_ips, f32_gflops, f32_err
+        ips, gflops, gbps, rel_err = f32_ips, f32_gflops, f32_gbps, f32_err
         mode = "f32 two-sweep"
 
     # NumPy single-process stand-in for the reference CPU engine
@@ -172,24 +198,39 @@ def child_main():
     components = []
     if os.environ.get("BENCH_COMPONENTS_PYLOPS_MPI_TPU", "1") != "0":
         try:
-            from benchmarks.bench_components import run_components
+            from benchmarks.bench_components import (
+                run_components, retry_failed_isolated)
+            # in-process first (an exclusively-locked TPU cannot host a
+            # second process), then retry failures one subprocess each:
+            # the remote-tunnel backend can poison its process state
+            # after the heavy headline solve (round-2 observation:
+            # everything after it returned UNIMPLEMENTED in-process but
+            # passed in isolation)
             components = run_components(quick=not on_tpu)
+            components = retry_failed_isolated(
+                components, quick=not on_tpu,
+                timeout=int(os.environ.get(
+                    "BENCH_COMPONENT_TIMEOUT", "150")))
         except Exception as e:  # components must never kill the headline
             components = [{"bench": "components", "error": repr(e)[:300]}]
 
     print(json.dumps({
         "metric": f"CGLS iters/sec (BlockDiag MatrixMult, {nblk}x{nblock}^2,"
-                  f" {n_dev} dev {platform}, {mode}, fused while_loop;"
-                  f" GEMM GFLOP/s={gflops:.0f}; rel_err={rel_err:.1e})",
+                  f" {n_dev} dev {platform}, {mode}, fused while_loop,"
+                  f" marginal per-iter timing; GEMM GFLOP/s={gflops:.0f};"
+                  f" rel_err={rel_err:.1e})",
         "value": round(ips, 2),
         "unit": "iters/s",
         "vs_baseline": round(ips / cpu_ips, 2),
         "mfu": mfu,
+        "hbm_gbps": round(gbps, 1),  # the roofline that matters: GEMV
+                                     # solves are HBM-bandwidth-bound
         "platform": platform,
         "n_devices": n_dev,
         "gflops": round(gflops, 1),
         "f32": {"iters_per_sec": round(f32_ips, 2),
                 "gflops": round(f32_gflops, 1),
+                "hbm_gbps": round(f32_gbps, 1),
                 "vs_baseline": round(f32_ips / cpu_ips, 2),
                 "rel_err": f"{f32_err:.1e}"},
         "numpy_baseline_iters_per_sec": round(cpu_ips, 2),

@@ -64,14 +64,12 @@ def MPINonStationaryConvolve1D(dims, hs, ih, axis: int = -1, mesh=None,
     dims_local = dims[axis] // size
     ihdiff = int(np.diff(ih)[0]) if len(ih) > 1 else 1
     dists = []
-    ihidx_all = []
     for r in range(size):
         start = r * dims_local
         end = start + dims_local - 1
         ihidx = np.where((ih >= start) & (ih <= end))[0]
         if len(ihidx) == 0:
             raise ValueError(f"shard {r} has zero filters!")
-        ihidx_all.append(ihidx)
         d_start = 0 if r == 0 else ihdiff - (ih[ihidx[0]] - start)
         d_end = 0 if r == size - 1 else ihdiff - (end - ih[ihidx[-1]])
         dists.extend([d_start, d_end])
