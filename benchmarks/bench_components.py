@@ -165,11 +165,12 @@ def _run_one_isolated(name: str, quick: bool, timeout: int):
     try:
         p = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout, env=dict(os.environ))
-        line = next((l for l in reversed(
-            (p.stdout or "").strip().splitlines())
-            if l.startswith("{")), None)
-        if line is not None:
-            return json.loads(line)
+        for l in reversed((p.stdout or "").strip().splitlines()):
+            if l.startswith("{"):
+                try:
+                    return json.loads(l)
+                except json.JSONDecodeError:  # truncated final line
+                    continue
         return {"bench": name, "error": f"rc={p.returncode}: "
                                         f"{(p.stderr or '')[-200:]}"}
     except subprocess.TimeoutExpired:
@@ -187,9 +188,10 @@ def retry_failed_isolated(results, quick: bool = False, timeout: int = 150):
     TPU that cannot host a second process). The modest per-config
     ``timeout`` keeps total retry time within the parent driver's child
     budget even if every retry hangs."""
+    known = {name for name, _ in _BENCHES}
     out = []
     for r in results:
-        if "error" in r and "bench" in r:
+        if "error" in r and r.get("bench") in known:
             _progress(f"{r['bench']} (isolated retry)")
             retried = _run_one_isolated(r["bench"], quick, timeout)
             out.append(retried if "error" not in retried else r)

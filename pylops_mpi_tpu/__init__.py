@@ -27,7 +27,7 @@ from .ops.nonstatconv import MPINonStationaryConvolve1D
 from .ops.fft import MPIFFTND, MPIFFT2D
 from .ops.fredholm import MPIFredholm1
 from .ops.mdc import MPIMDC
-from .solvers.basic import CG, CGLS, cg, cgls
+from .solvers.basic import CG, CGLS, cg, cgls, clear_fused_cache
 from .solvers.sparsity import ISTA, FISTA, ista, fista
 from .solvers.eigs import power_iteration
 from .utils.dottest import dottest

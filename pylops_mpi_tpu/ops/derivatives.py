@@ -267,9 +267,14 @@ class MPIGradient(MPILinearOperator):
                  edge: bool = False, mesh=None, dtype=np.float64):
         self.dims_nd = _tuplize(dims)
         ndims = len(self.dims_nd)
-        sampling = _tuplize(sampling) if np.ndim(sampling) else (sampling,) * ndims
+        # NOT _tuplize: sampling is a float spacing, an int cast would
+        # truncate e.g. 0.5 -> 0 and blow up the stencils
+        sampling = tuple(float(s) for s in np.atleast_1d(sampling))
         if len(sampling) == 1:
             sampling = sampling * ndims
+        if len(sampling) != ndims:
+            raise ValueError(
+                f"sampling must have 1 or {ndims} entries, got {len(sampling)}")
         self.sampling = sampling
         self.kind = kind
         self.edge = edge

@@ -1,3 +1,3 @@
-from .basic import CG, CGLS, cg, cgls
+from .basic import CG, CGLS, cg, cgls, clear_fused_cache
 from .sparsity import ISTA, FISTA, ista, fista
 from .eigs import power_iteration

@@ -284,3 +284,48 @@ def test_explicit_stencil_nd_and_fallbacks(rng):
     er = np.zeros(13)
     er[1:-1] = (xr[2:] - xr[:-2]) / 2
     np.testing.assert_allclose(Drag.matvec(dr).asarray(), er, rtol=1e-12)
+
+
+def test_laplacian_3d(rng):
+    """3-D Laplacian over all three axes (the poststack/LSM regularizer
+    shape), dense Kronecker oracle."""
+    dims = (8, 5, 4)
+    Lop = MPILaplacian(dims, axes=(0, 1, 2), weights=(1, 2, 3),
+                       sampling=(1, 1, 2), dtype=np.float64)
+    D0 = _second_deriv_dense(dims[0], 1, "centered", False)
+    D1 = _second_deriv_dense(dims[1], 1, "centered", False)
+    D2 = _second_deriv_dense(dims[2], 2, "centered", False)
+    eye = np.eye
+    D = (1 * np.kron(D0, np.kron(eye(dims[1]), eye(dims[2])))
+         + 2 * np.kron(eye(dims[0]), np.kron(D1, eye(dims[2])))
+         + 3 * np.kron(eye(dims[0]), np.kron(eye(dims[1]), D2)))
+    x = rng.standard_normal(np.prod(dims))
+    dx = DistributedArray.to_dist(x)
+    np.testing.assert_allclose(Lop.matvec(dx).asarray(), D @ x,
+                               rtol=1e-11, atol=1e-11)
+    np.testing.assert_allclose(Lop.rmatvec(dx).asarray(), D.T @ x,
+                               rtol=1e-11, atol=1e-11)
+
+
+def test_gradient_3d(rng):
+    """3-D Gradient: three stacked first derivatives (ref
+    Gradient.py:100-118)."""
+    dims = (8, 4, 3)
+    Gop = MPIGradient(dims, sampling=(1.0, 2.0, 0.5), dtype=np.float64)
+    x = rng.standard_normal(np.prod(dims))
+    dx = DistributedArray.to_dist(x)
+    y = Gop.matvec(dx)
+    assert y.narrays == 3
+    D = [np.kron(np.kron(
+        _first_deriv_dense(dims[0], 1.0, "centered", False)
+        if ax == 0 else np.eye(dims[0]),
+        _first_deriv_dense(dims[1], 2.0, "centered", False)
+        if ax == 1 else np.eye(dims[1])),
+        _first_deriv_dense(dims[2], 0.5, "centered", False)
+        if ax == 2 else np.eye(dims[2])) for ax in range(3)]
+    for ax in range(3):
+        np.testing.assert_allclose(y[ax].asarray(), D[ax] @ x,
+                                   rtol=1e-11, atol=1e-11)
+    got = Gop.rmatvec(y).asarray()
+    expected = sum(D[ax].T @ (D[ax] @ x) for ax in range(3))
+    np.testing.assert_allclose(got, expected, rtol=1e-10, atol=1e-10)

@@ -426,3 +426,35 @@ def test_asarray_matches_array_property(rng):
     np.testing.assert_allclose(dx.asarray(), np.asarray(dx.array),
                                rtol=1e-14)
     np.testing.assert_allclose(dx.asarray(), x, rtol=1e-14)
+
+
+def test_unsafe_broadcast_equivalence(rng):
+    """UNSAFE_BROADCAST behaves as BROADCAST (a replicated jax.Array
+    cannot drift between devices — documented semantic departure)."""
+    x = rng.standard_normal(12)
+    du = DistributedArray.to_dist(x, partition=Partition.UNSAFE_BROADCAST)
+    db = DistributedArray.to_dist(x, partition=Partition.BROADCAST)
+    np.testing.assert_allclose(du.asarray(), db.asarray(), rtol=1e-14)
+    np.testing.assert_allclose((du * 2).asarray(), 2 * x, rtol=1e-14)
+    assert du.partition == Partition.UNSAFE_BROADCAST
+
+
+def test_to_dist_uneven_axis1(rng):
+    """Custom ragged local shapes on a non-leading axis."""
+    x = rng.standard_normal((3, 11))
+    shapes = [(3, 3), (3, 2), (3, 1), (3, 1), (3, 1), (3, 1), (3, 1),
+              (3, 1)]
+    dx = DistributedArray.to_dist(x, axis=1, local_shapes=shapes)
+    np.testing.assert_allclose(dx.asarray(), x, rtol=1e-14)
+    assert dx.local_shapes == tuple(shapes)
+    np.testing.assert_allclose(float(dx.norm(2)),
+                               np.linalg.norm(x.ravel()), rtol=1e-12)
+
+
+def test_masked_redistribute_keeps_mask(rng):
+    mask = [0, 0, 0, 0, 1, 1, 1, 1]
+    x = rng.standard_normal((8, 6))
+    dx = DistributedArray.to_dist(x, axis=0, mask=mask)
+    dy = dx.redistribute(1)
+    assert dy.mask == tuple(mask)
+    np.testing.assert_allclose(dy.asarray(), x, rtol=1e-14)

@@ -1,0 +1,105 @@
+"""Mesh/grid helper + fft-helper + partition-map tests (SURVEY §2.1/§2.5
+aux components: grid selection, shift helpers, static layout maps)."""
+
+import numpy as np
+import pytest
+import jax
+
+from pylops_mpi_tpu import DistributedArray
+from pylops_mpi_tpu.parallel.mesh import (make_mesh, make_mesh_2d,
+                                          best_grid_2d, axis_sharding,
+                                          replicated_sharding)
+from pylops_mpi_tpu.parallel.partition import (Partition, local_split,
+                                               shard_offsets,
+                                               padded_shard_size,
+                                               pad_index_map,
+                                               unpad_index_map)
+from pylops_mpi_tpu.utils import fftshift_nd, ifftshift_nd
+
+
+@pytest.mark.parametrize("n,expected_prod", [(8, 8), (6, 6), (4, 4),
+                                             (1, 1), (7, 7), (12, 12)])
+def test_best_grid_2d_properties(n, expected_prod):
+    """best_grid_2d factors P into the most-square grid (the analog of
+    ref active_grid_comm, MatrixMult.py:24-79 — we factor instead of
+    idling ranks)."""
+    pr, pc = best_grid_2d(n)
+    assert pr * pc == expected_prod
+    # most-square: no better factorization exists
+    for a in range(1, n + 1):
+        if n % a == 0:
+            assert abs(pr - pc) <= abs(a - n // a)
+
+
+def test_make_mesh_2d_shapes():
+    m = make_mesh_2d(grid=(2, 4))
+    assert m.devices.shape == (2, 4)
+    assert m.axis_names == ("r", "c")
+    with pytest.raises(ValueError):
+        make_mesh_2d(grid=(3, 3))  # does not tile 8 devices
+
+
+def test_axis_sharding_specs():
+    mesh = make_mesh()
+    sh = axis_sharding(mesh, 3, 1)
+    assert sh.spec[1] == mesh.axis_names[0]
+    assert sh.spec[0] is None and sh.spec[2] is None
+    rep = replicated_sharding(mesh)
+    assert all(s is None for s in (rep.spec or [None]))
+
+
+@pytest.mark.parametrize("n,p", [(16, 8), (17, 8), (3, 8), (100, 7)])
+def test_local_split_invariants(n, p):
+    shapes = local_split((n,), p, Partition.SCATTER, 0)
+    sizes = [s[0] for s in shapes]
+    assert sum(sizes) == n
+    assert max(sizes) - min(sizes) <= 1
+    assert sizes == sorted(sizes, reverse=True)  # big shards first
+    offs = shard_offsets(sizes)
+    assert offs[0] == 0 and len(offs) == p
+    assert padded_shard_size(sizes) == max(sizes)
+
+
+@pytest.mark.parametrize("sizes", [[3, 3, 2], [4, 0, 1], [2, 2, 2]])
+def test_pad_unpad_maps_roundtrip(sizes):
+    """pad_index_map/unpad_index_map compose to the identity on the
+    logical axis for any monotone split, zero-size shards included."""
+    n = sum(sizes)
+    sp = padded_shard_size(sizes)
+    src, valid = pad_index_map(sizes, sp)
+    unpad = unpad_index_map(sizes, sp)
+    x = np.arange(n)
+    phys = np.where(valid, x[src], 0)
+    np.testing.assert_array_equal(phys[unpad], x)
+    assert valid.sum() == n
+
+
+def test_fftshift_helpers_sweep(rng):
+    """Distributed fftshift/ifftshift across sharded and local axes,
+    odd and even extents (ref utils/fft_helper.py:11-105)."""
+    for shape, axes in (((8, 6), (0,)), ((8, 6), (1,)), ((9, 5), (0, 1)),
+                        ((13,), (0,))):
+        x = rng.standard_normal(shape)
+        dx = DistributedArray.to_dist(x, axis=0)
+        np.testing.assert_allclose(fftshift_nd(dx, axes=axes).asarray(),
+                                   np.fft.fftshift(x, axes=axes),
+                                   rtol=1e-14)
+        np.testing.assert_allclose(ifftshift_nd(dx, axes=axes).asarray(),
+                                   np.fft.ifftshift(x, axes=axes),
+                                   rtol=1e-14)
+        # roundtrip
+        np.testing.assert_allclose(
+            ifftshift_nd(fftshift_nd(dx, axes=axes), axes=axes).asarray(),
+            x, rtol=1e-14)
+
+
+def test_kernel_to_frequency(rng):
+    from pylops_mpi_tpu.models import kernel_to_frequency
+    ns, nr, nt = 3, 4, 16
+    Gt = rng.standard_normal((ns, nr, nt))
+    Gf = kernel_to_frequency(Gt)
+    assert Gf.shape[0] <= nt // 2 + 1
+    np.testing.assert_allclose(
+        Gf[1], np.fft.rfft(Gt, nt, axis=-1)[:, :, 1], rtol=1e-12)
+    Gf4 = kernel_to_frequency(Gt, nfmax=4)
+    assert Gf4.shape[0] == 4
