@@ -195,6 +195,9 @@ def child_main():
                     "BENCH_COMPONENT_TIMEOUT", "150")))
         except Exception as e:  # components must never kill the headline
             components = [{"bench": "components", "error": repr(e)[:300]}]
+        # release fused-solver cache entries (compiled executables +
+        # pinned operator buffers) before the memory-heaviest solve
+        pmt.clear_fused_cache()
 
     # bf16 block storage (the native TPU matrix format) halves HBM
     # traffic of the memory-bound matvec; MXU accumulates in f32. The

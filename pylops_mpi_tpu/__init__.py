@@ -31,6 +31,7 @@ from .solvers.basic import CG, CGLS, cg, cgls, clear_fused_cache
 from .solvers.sparsity import ISTA, FISTA, ista, fista
 from .solvers.eigs import power_iteration
 from .utils.dottest import dottest
+from .plotting.plotting import plot_distributed_array, plot_local_arrays
 
 from . import ops
 from . import solvers

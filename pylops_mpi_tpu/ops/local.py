@@ -29,8 +29,8 @@ class LocalOperator:
     """Minimal pylops-like operator protocol over jnp arrays."""
 
     def __init__(self, dims, dimsd, dtype=None, name: str = "L"):
-        self.dims = tuple(int(d) for d in np.atleast_1d(dims))
-        self.dimsd = tuple(int(d) for d in np.atleast_1d(dimsd))
+        self.dims = tuple(int(d) for d in np.ravel(dims))
+        self.dimsd = tuple(int(d) for d in np.ravel(dimsd))
         self.shape = (int(np.prod(self.dimsd)), int(np.prod(self.dims)))
         self.dtype = np.dtype(dtype) if dtype is not None else np.dtype("float32")
         self.name = name

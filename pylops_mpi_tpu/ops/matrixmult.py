@@ -48,7 +48,43 @@ from ..distributedarray import DistributedArray, Partition, local_split
 from ..linearoperator import MPILinearOperator
 from ..parallel.mesh import default_mesh, make_mesh_2d, best_grid_2d
 
-__all__ = ["MPIMatrixMult", "local_block_split", "block_gather"]
+__all__ = ["MPIMatrixMult", "active_grid_comm", "local_block_split",
+           "block_gather"]
+
+
+def active_grid_comm(N: int, M: int, n_devices: Optional[int] = None,
+                     axis_names: Tuple[str, str] = ("r", "c")):
+    """Largest-square active process grid for a distributed matmul —
+    one-controller analog of ref ``MatrixMult.py:24-79``
+    (``active_grid_comm(base_comm, N, M)``).
+
+    The reference assigns every MPI rank a position in a ``P'×P'``
+    logical grid (``P' = isqrt(P)``), caps the active dimension by
+    ``min(N, M)``, and returns a sub-communicator of the active ranks
+    (inactive ranks idle). Here there are no per-rank return values:
+    the same selection yields a 2-D :class:`jax.sharding.Mesh` over the
+    active devices only.
+
+    Returns ``(mesh, grid, active_ids, is_full)``: the active 2-D mesh,
+    its ``(d, d)`` grid shape, the flat indices (into ``jax.devices()``)
+    of the participating devices in row-major grid order, and whether
+    every device participates. Prefer :func:`best_grid_2d` (which
+    factors the device count so nothing idles) when grid squareness is
+    not required.
+    """
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    if n_devices > len(devs):
+        raise ValueError(
+            f"requested {n_devices} devices but only {len(devs)} available")
+    p_prime = int(np.sqrt(n_devices))
+    d = max(1, min(int(N), int(M), p_prime))
+    # row-major positions of the active sub-grid within the P'x P' grid
+    active_ids = [r * p_prime + c for r in range(d) for c in range(d)]
+    mesh = Mesh(np.asarray([devs[i] for i in active_ids]).reshape(d, d),
+                axis_names)
+    return mesh, (d, d), active_ids, len(active_ids) == n_devices
 
 
 def local_block_split(global_shape: Tuple[int, int], rank: int,

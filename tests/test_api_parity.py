@@ -22,6 +22,8 @@ def test_top_level_surface():
             "MPIFFT2D", "MPIMDC",
             "cg", "cgls", "CG", "CGLS", "ista", "fista", "ISTA", "FISTA",
             "dottest",
+            # ref exports plotting at top level (pylops_mpi/__init__.py:12)
+            "plot_distributed_array", "plot_local_arrays",
     ]:
         assert hasattr(pmt, name), f"missing top-level symbol {name}"
 
@@ -32,7 +34,10 @@ def test_namespace_shims():
     from pylops_mpi_tpu.basicoperators import (
         MPIBlockDiag, MPIVStack, MPIHStack, MPIMatrixMult,
         MPIFirstDerivative, MPISecondDerivative, MPILaplacian,
-        MPIGradient, MPIHalo, halo_block_split)
+        MPIGradient, MPIHalo, halo_block_split,
+        # matmul grid helpers live in the same namespace as the ref
+        # (pylops_mpi/basicoperators/MatrixMult.py:1-6)
+        active_grid_comm, local_block_split, block_gather)
     from pylops_mpi_tpu.signalprocessing import (
         MPIFredholm1, MPIFFTND, MPIFFT2D, MPINonStationaryConvolve1D)
     from pylops_mpi_tpu.waveeqprocessing import MPIMDC

@@ -31,6 +31,34 @@ def test_best_grid_2d_properties(n, expected_prod):
             assert abs(pr - pc) <= abs(a - n // a)
 
 
+@pytest.mark.parametrize("N,M,exp_d", [(64, 64, 2), (1, 64, 1), (64, 2, 2),
+                                       (3, 3, 2)])
+def test_active_grid_comm(N, M, exp_d):
+    """Largest-square active grid with min(N, M) cap and row-major
+    device selection (ref MatrixMult.py:24-79 semantics), plus a SUMMA
+    matmul running on the returned sub-mesh."""
+    from pylops_mpi_tpu.basicoperators import active_grid_comm
+    mesh, grid, active, is_full = active_grid_comm(N, M, n_devices=8)
+    d = min(N, M, 2)  # isqrt(8) == 2
+    assert grid == (d, d) == (exp_d, exp_d)
+    assert mesh.devices.shape == grid
+    p_prime = 2
+    assert active == [r * p_prime + c for r in range(d) for c in range(d)]
+    assert is_full == (len(active) == 8)
+
+    # the returned mesh drives a real SUMMA product
+    import pylops_mpi_tpu as pmt
+    rng = np.random.default_rng(0)
+    A = rng.standard_normal((6, 5)).astype(np.float32)
+    X = rng.standard_normal((5, 4)).astype(np.float32)
+    mesh1 = make_mesh(len(active))
+    Mop = pmt.MPIMatrixMult(A, M=4, kind="summa", mesh=mesh1,
+                            grid=grid, dtype=np.float32)
+    y = Mop.matvec(pmt.DistributedArray.to_dist(X.ravel(), mesh=mesh1))
+    np.testing.assert_allclose(np.asarray(y.asarray()).reshape(6, 4),
+                               A @ X, rtol=2e-4)
+
+
 def test_make_mesh_2d_shapes():
     m = make_mesh_2d(grid=(2, 4))
     assert m.devices.shape == (2, 4)
