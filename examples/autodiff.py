@@ -1,0 +1,53 @@
+"""Machine-derived gradients through distributed operators — capability
+beyond the reference (its per-rank NumPy matvecs,
+``pylops_mpi/LinearOperator.py:194-204``, are opaque to autodiff).
+
+Solves a Tikhonov-regularized problem by plain gradient descent where
+the gradient of ``0.5||Ax - y||² + ε||∇x||²`` is produced by
+``jax.grad`` through the BlockDiag matvec AND the distributed
+first-derivative's halo exchange, all under one jit.
+"""
+import _setup  # noqa: F401
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pylops_mpi_tpu as pmt
+from pylops_mpi_tpu.ops.local import MatrixMult
+
+rng = np.random.default_rng(3)
+ndev = int(pmt.default_mesh().devices.size)
+n = 16
+N = ndev * n
+blocks = [rng.standard_normal((n, n)) + n * np.eye(n) for _ in range(ndev)]
+Aop = pmt.MPIBlockDiag([MatrixMult(b, dtype=np.float64) for b in blocks])
+Dop = pmt.MPIFirstDerivative((N,), dtype=np.float64)
+
+x_true = np.cumsum(rng.standard_normal(N)) / 4
+y = np.concatenate([b @ x_true[i * n:(i + 1) * n]
+                    for i, b in enumerate(blocks)])
+dy = pmt.DistributedArray.to_dist(y)
+
+
+@jax.jit
+def step(xd, lr):
+    def objective(xx):
+        r = Aop.matvec(xx) - dy
+        d = Dop.matvec(xx)
+        return 0.5 * jnp.vdot(r._arr, r._arr).real \
+            + 0.05 * jnp.vdot(d._arr, d._arr).real
+    val, g = jax.value_and_grad(objective)(xd)
+    return xd - lr * g, val
+
+
+x = pmt.DistributedArray.to_dist(np.zeros(N))
+for it in range(200):
+    x, obj = step(x, 5e-4)
+    # serialize dispatch: on the CPU-sim mesh, concurrent in-flight
+    # executions of a collective program can starve each other's
+    # rendezvous threads (device-ordered execution on real TPU has no
+    # such pileup). The fused solvers are immune — their whole loop is
+    # ONE program.
+    obj.block_until_ready()
+err = np.linalg.norm(x.asarray() - x_true) / np.linalg.norm(x_true)
+print(f"autodiff GD: obj={float(obj):.3e} rel_err={err:.2e}")
+assert err < 0.1

@@ -27,10 +27,11 @@ replicates X across grid rows (its global length is ``K * Σ_ranks
 M_loc ≈ K·M·√P``, ref ``306-316``); here model and data are the unique
 ``(K·M,)`` / ``(N·M,)`` vectors — same operator, no duplicated storage.
 
-Grid helpers mirror ref ``MatrixMult.py:24-175``: ``best_grid_2d``
-replaces ``active_grid_comm`` (we factor P instead of idling ranks),
-``local_block_split`` gives tile ownership slices, ``block_gather``
-reassembles a tiled matrix.
+Grid helpers mirror ref ``MatrixMult.py:24-175``: ``active_grid_comm``
+is the reference-faithful analog (largest square grid, surplus devices
+idle); ``best_grid_2d`` is the preferred no-idle alternative (factors P
+into the most-square grid); ``local_block_split`` gives tile ownership
+slices, ``block_gather`` reassembles a tiled matrix.
 """
 
 from __future__ import annotations

@@ -46,15 +46,15 @@ def test_active_grid_comm(N, M, exp_d):
     assert active == [r * p_prime + c for r in range(d) for c in range(d)]
     assert is_full == (len(active) == 8)
 
-    # the returned mesh drives a real SUMMA product
+    # the returned mesh itself drives a real SUMMA product (its device
+    # array reshapes to the grid inside _MPISummaMatrixMult)
     import pylops_mpi_tpu as pmt
     rng = np.random.default_rng(0)
     A = rng.standard_normal((6, 5)).astype(np.float32)
     X = rng.standard_normal((5, 4)).astype(np.float32)
-    mesh1 = make_mesh(len(active))
-    Mop = pmt.MPIMatrixMult(A, M=4, kind="summa", mesh=mesh1,
+    Mop = pmt.MPIMatrixMult(A, M=4, kind="summa", mesh=mesh,
                             grid=grid, dtype=np.float32)
-    y = Mop.matvec(pmt.DistributedArray.to_dist(X.ravel(), mesh=mesh1))
+    y = Mop.matvec(pmt.DistributedArray.to_dist(X.ravel(), mesh=mesh))
     np.testing.assert_allclose(np.asarray(y.asarray()).reshape(6, 4),
                                A @ X, rtol=2e-4)
 

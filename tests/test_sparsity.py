@@ -312,3 +312,67 @@ def test_ista_half_threshold(rng):
     got = x.asarray()
     assert np.sum(np.abs(got) > 0.3) <= 6
     assert {7, 25} <= set(np.flatnonzero(np.abs(got) > 0.3))
+
+
+# ------------------------------------------------- SOp (sparsifying op)
+
+def _orthogonal_blockdiag(rng, nblk, bn):
+    """MPIBlockDiag of per-block orthogonal matrices + its dense form."""
+    import scipy.linalg as spla
+    qs = [np.linalg.qr(rng.standard_normal((bn, bn)))[0] for _ in range(nblk)]
+    SOp = MPIBlockDiag([MatrixMult(q, dtype=np.float64) for q in qs])
+    return SOp, spla.block_diag(*qs)
+
+
+def _np_ista_sop(A, Q, y, eps, niter, alpha):
+    """NumPy ISTA thresholding in the Q-adjoint domain then mapping back
+    (ref cls_sparsity.py SOp handling: rmatvec -> threshold -> matvec)."""
+    x = np.zeros(A.shape[1])
+    thresh = eps * alpha * 0.5
+    for _ in range(niter):
+        g = x + alpha * (A.T @ (y - A @ x))
+        s = Q.T @ g
+        s = np.sign(s) * np.maximum(np.abs(s) - thresh, 0.0)
+        x = Q @ s
+    return x
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_ista_sop_oracle(rng, fused):
+    """ISTA with a sparsifying transform: model is dense, its Q-domain
+    coefficients are sparse. Must track the NumPy SOp recurrence exactly
+    (ref cls_sparsity.py:309-343 SOp branches)."""
+    Op, dense = _bd_problem(rng, 6, 4)
+    SOp, Qd = _orthogonal_blockdiag(rng, 8, 4)
+    strue = np.zeros(32)
+    strue[[2, 13, 27]] = [2.0, -1.5, 1.0]
+    xtrue = Qd @ strue          # sparse in Q domain, dense in model domain
+    y = dense @ xtrue
+    eps, alpha, niter = 0.08, 0.25, 40
+    dy = DistributedArray.to_dist(y)
+    x0 = DistributedArray.to_dist(np.zeros(32))
+    x, niters, cost = ista(Op, dy, x0, niter=niter, eps=eps, alpha=alpha,
+                           SOp=SOp, fused=fused, tol=0.0)
+    expected = _np_ista_sop(dense, Qd, y, eps, niter, alpha)
+    np.testing.assert_allclose(x.asarray(), expected, rtol=1e-9, atol=1e-11)
+    # and the Q-domain coefficients of the solution are actually sparse
+    coeffs = Qd.T @ np.asarray(x.asarray())
+    assert np.sum(np.abs(coeffs) > 0.3) <= 8
+
+
+def test_fista_sop_fused_eager_parity(rng):
+    """FISTA accepts SOp on both paths and fused == eager exactly."""
+    Op, dense = _bd_problem(rng, 6, 4)
+    SOp, Qd = _orthogonal_blockdiag(rng, 8, 4)
+    strue = np.zeros(32)
+    strue[[5, 19]] = [1.5, -2.0]
+    y = dense @ (Qd @ strue)
+    dy = DistributedArray.to_dist(y)
+    x0 = DistributedArray.to_dist(np.zeros(32))
+    xf, nf, cf = fista(Op, dy, x0, niter=30, eps=0.05, alpha=0.25,
+                       SOp=SOp, fused=True, tol=0.0)
+    xe, ne, ce = fista(Op, dy, x0, niter=30, eps=0.05, alpha=0.25,
+                       SOp=SOp, fused=False, tol=0.0)
+    np.testing.assert_allclose(xf.asarray(), xe.asarray(), rtol=1e-9,
+                               atol=1e-12)
+    np.testing.assert_allclose(np.asarray(cf), np.asarray(ce), rtol=1e-8)
