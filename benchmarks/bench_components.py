@@ -114,15 +114,33 @@ def _bench_fredholm(pmt, rng, n_dev, scale):
 
 
 def _bench_poststack(pmt, rng, n_dev, scale):
+    import jax
     from pylops_mpi_tpu.models import ricker, poststack_inversion
+    from pylops_mpi_tpu.solvers.basic import cgls
     nt0, nxs = 256, 64 * n_dev * scale
     wav = ricker(np.arange(31) * 0.004, f0=15)[0].astype(np.float32)
-    m = rng.standard_normal((nxs, nt0)).astype(np.float32)
+    d = rng.standard_normal((nxs, nt0)).astype(np.float32)
+    # cold: the SHIPPED pipeline end to end, incl. operator build +
+    # compile (the one-shot user experience)
     t0 = time.perf_counter()
-    poststack_inversion(m, wav, niter=10, dtype=np.float32)
-    dt = time.perf_counter() - t0
-    return {"bench": "poststack_inversion", "value": round(dt, 3),
-            "unit": "s (incl. compile)", "shape": f"{nxs}x{nt0},10it"}
+    _, Op = poststack_inversion(d, wav, niter=10, dtype=np.float32)
+    cold = time.perf_counter() - t0
+    # warm: re-solve on the SAME operator (compiled executable reused —
+    # the iterative-workflow rate); same solver settings as the pipeline
+    dy = pmt.DistributedArray.to_dist(d.ravel(), mesh=Op.mesh,
+                                      local_shapes=Op.local_shapes_n)
+    x0 = pmt.DistributedArray(global_shape=Op.shape[1], mesh=Op.mesh,
+                              local_shapes=Op.local_shapes_m,
+                              dtype=np.float32)
+    warm = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        x, *_ = cgls(Op, dy, x0, niter=10, damp=1e-4, tol=1e-10)
+        jax.block_until_ready(x._arr)
+        warm = min(warm, time.perf_counter() - t0)
+    return {"bench": "poststack_inversion", "value": round(warm, 3),
+            "unit": "s (warm, 10it)", "cold_s": round(cold, 3),
+            "shape": f"{nxs}x{nt0},10it"}
 
 
 _BENCHES = [("first_derivative_halo", _bench_first_derivative),

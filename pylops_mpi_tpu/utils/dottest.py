@@ -1,6 +1,17 @@
 """Distributed adjoint (dot) test — rebuild of
 ``pylops_mpi/utils/dottest.py:11-107``: checks
 ``(Op u)ᴴ v == uᴴ (Opᴴ v)`` on gathered global arrays.
+
+The MPI reference requires caller-provided ``u``/``v``; serial pylops'
+``dottest`` generates them. This build follows the serial convention as
+an extension: ``u``/``v`` may be omitted and random test vectors are
+generated to match the operator's shape, with ``complexflag`` selecting
+which side is complex (0: both real, 1: model complex, 2: data complex,
+3: both complex) and ``seed`` (default 42) keeping failures
+reproducible. The data-side vector is generated from a probe ``matvec``
+so its layout (ragged shards, halo extents, stacked structure) always
+matches; operators whose MODEL space is stacked
+(e.g. ``MPIStackedBlockDiag``) still need explicit ``u``/``v``.
 """
 
 from __future__ import annotations
@@ -12,17 +23,82 @@ import numpy as np
 __all__ = ["dottest"]
 
 
-def dottest(Op, u, v, nr: Optional[int] = None, nc: Optional[int] = None,
+def _dtype_for(Op, cmplx):
+    return np.promote_types(np.dtype(Op.dtype),
+                            np.complex64 if cmplx else np.float32)
+
+
+def _rand_model(Op, n, cmplx, rng):
+    """Random model-side vector honouring the operator's model layout
+    when it exposes one (``local_shapes_m``; MPIHalo's model side is
+    ``local_dim_sizes``)."""
+    from ..distributedarray import DistributedArray
+    x = rng.standard_normal(n)
+    if cmplx:
+        x = x + 1j * rng.standard_normal(n)
+    shapes = getattr(Op, "local_shapes_m",
+                     getattr(Op, "local_dim_sizes", None))
+    return DistributedArray.to_dist(x.astype(_dtype_for(Op, cmplx)),
+                                    mesh=getattr(Op, "mesh", None),
+                                    local_shapes=shapes)
+
+
+def _rand_like(d, cmplx, rng, dtype):
+    """Random vector with the exact structure/layout of ``d`` (plain or
+    stacked) — used for the data side, whose layout is taken from a
+    probe ``matvec`` so layout-sensitive operators (halo, ragged
+    blockdiag, stacked outputs) get valid cotangents."""
+    from ..distributedarray import DistributedArray
+    from ..stacked import StackedDistributedArray
+    if isinstance(d, StackedDistributedArray):
+        return StackedDistributedArray(
+            [_rand_like(a, cmplx, rng, dtype) for a in d.distarrays])
+    from ..parallel.partition import Partition
+    x = rng.standard_normal(d.global_shape)
+    if cmplx:
+        x = x + 1j * rng.standard_normal(d.global_shape)
+    scatter = d.partition == Partition.SCATTER
+    return DistributedArray.to_dist(
+        x.astype(dtype), mesh=d.mesh, axis=d.axis,
+        partition=d.partition, mask=d.mask,
+        local_shapes=d.local_shapes if scatter else None)
+
+
+def dottest(Op, u=None, v=None, nr: Optional[int] = None,
+            nc: Optional[int] = None, complexflag: int = 0,
             rtol: float = 1e-6, atol: float = 1e-21,
-            raiseerror: bool = True, verb: bool = False) -> bool:
+            raiseerror: bool = True, verb: bool = False,
+            seed: Optional[int] = 42) -> bool:
     if nr is None:
         nr = Op.shape[0]
     if nc is None:
         nc = Op.shape[1]
     if (nr, nc) != Op.shape:
         raise AssertionError("Provided nr and nc do not match operator shape")
+    if complexflag not in (0, 1, 2, 3):
+        raise ValueError(f"complexflag must be 0, 1, 2 or 3, "
+                         f"got {complexflag}")
 
-    y = Op.matvec(u)
+    rng = np.random.default_rng(seed)
+    u_auto = u is None
+    if u_auto:
+        u = _rand_model(Op, nc, complexflag in (1, 3), rng)
+
+    try:
+        y = Op.matvec(u)
+    except (ValueError, TypeError) as e:
+        if u_auto:
+            # layout/type rejection of the generated vector (stacked or
+            # bespoke model space); genuine operator errors re-raise
+            # below with this chained for diagnosis
+            raise TypeError(
+                "dottest could not auto-generate a model vector for this "
+                "operator (stacked or bespoke model space) — pass u (and "
+                "v) explicitly") from e
+        raise
+    if v is None:
+        v = _rand_like(y, complexflag in (2, 3), rng,
+                       _dtype_for(Op, complexflag in (2, 3)))
     x = Op.rmatvec(v)
 
     yy = np.vdot(y.asarray(), v.asarray())

@@ -102,6 +102,30 @@ def test_pad_unpad_maps_roundtrip(sizes):
     assert valid.sum() == n
 
 
+def test_initialize_multihost_passthrough(monkeypatch):
+    """initialize_multihost forwards the bootstrap args to
+    jax.distributed.initialize (the mpiexec/NCCL-unique-id analog,
+    ref utils/_nccl.py:98-132) without touching them."""
+    import jax.distributed
+    from pylops_mpi_tpu.parallel.mesh import initialize_multihost
+    seen = {}
+
+    def fake_init(coordinator_address=None, num_processes=None,
+                  process_id=None):
+        seen.update(coordinator_address=coordinator_address,
+                    num_processes=num_processes, process_id=process_id)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    initialize_multihost("10.0.0.1:1234", num_processes=4, process_id=2)
+    assert seen == {"coordinator_address": "10.0.0.1:1234",
+                    "num_processes": 4, "process_id": 2}
+    # default: auto-detection (all None) is passed through unchanged
+    seen.clear()
+    initialize_multihost()
+    assert seen == {"coordinator_address": None, "num_processes": None,
+                    "process_id": None}
+
+
 def test_fftshift_helpers_sweep(rng):
     """Distributed fftshift/ifftshift across sharded and local axes,
     odd and even extents (ref utils/fft_helper.py:11-105)."""
