@@ -164,6 +164,26 @@ class MPILinearOperator:
     def __sub__(self, x):
         return self.__add__(-x)
 
+    def todense(self) -> np.ndarray:
+        """Dense matrix of the operator, by applying it to each identity
+        column and gathering (serial-pylops convenience; the MPI
+        reference has no equivalent because no rank holds the global
+        matrix). O(n) matvecs — intended for tests and small operators."""
+        from .distributedarray import DistributedArray
+        m, n = self.shape
+        dt = np.dtype(self.dtype)
+        mesh = getattr(self, "mesh", None)
+        shapes = getattr(self, "local_shapes_m",
+                         getattr(self, "local_dim_sizes", None))
+        out = np.zeros((m, n), dtype=dt)
+        for j in range(n):
+            e = np.zeros(n, dtype=dt)
+            e[j] = 1
+            col = self.matvec(DistributedArray.to_dist(
+                e, mesh=mesh, local_shapes=shapes))
+            out[:, j] = np.asarray(col.asarray())
+        return out
+
     def __repr__(self):
         M, N = self.shape
         dt = "unspecified dtype" if self.dtype is None else f"dtype={self.dtype}"

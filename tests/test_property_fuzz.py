@@ -20,16 +20,8 @@ from pylops_mpi_tpu.ops.local import MatrixMult
 
 
 def _dense_of(Op):
-    """Dense matrix of a distributed operator by probing columns."""
-    m, n = Op.shape
-    D = np.zeros((m, n), dtype=np.complex128 if np.issubdtype(
-        np.dtype(Op.dtype), np.complexfloating) else np.float64)
-    for j in range(n):
-        e = np.zeros(n, dtype=D.dtype)
-        e[j] = 1.0
-        D[:, j] = np.asarray(
-            Op.matvec(DistributedArray.to_dist(e)).asarray())
-    return D
+    """Dense matrix of a distributed operator (Op.todense())."""
+    return Op.todense()
 
 
 def _rand_square_op(rng, n, cmplx):

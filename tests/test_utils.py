@@ -186,3 +186,112 @@ def test_solver_checkpoint_cgls_fresh_process_shape(tmp_path, rng):
     while s2.iiter < 16:
         x2 = s2.step(x2)
     np.testing.assert_allclose(x2.asarray(), xr.asarray(), rtol=1e-9)
+
+
+# ----------------------------------------------- collective-schedule HLO
+
+def test_collective_report_stencil(rng):
+    """The stencil's compiled schedule shows collective-permute traffic
+    and no oversized all-gather (utils.hlo observability layer)."""
+    import jax
+    from pylops_mpi_tpu import DistributedArray, MPIFirstDerivative
+    from pylops_mpi_tpu.utils import (collective_report,
+                                      assert_no_full_gather)
+    n = 64
+    D = MPIFirstDerivative((n,), kind="centered", dtype=np.float32)
+    x = DistributedArray.to_dist(rng.standard_normal(n).astype(np.float32))
+
+    def f(v):
+        return D.matvec(v).array
+
+    rep = collective_report(f, x)
+    assert rep.get("collective-permute", {}).get("count", 0) >= 2
+    # boundary slabs only: each permuted slab is 1 row of 4 bytes
+    assert rep["collective-permute"]["bytes"] <= 8 * n
+    rep2 = assert_no_full_gather(f, x, max_fraction=0.5)
+    assert rep2 == rep
+
+
+def test_assert_no_full_gather_catches_replication(rng):
+    """A deliberately replicating program trips the assertion."""
+    import jax
+    import jax.numpy as jnp
+    from pylops_mpi_tpu import DistributedArray
+    from pylops_mpi_tpu.utils import assert_no_full_gather
+    from pylops_mpi_tpu.parallel.mesh import (default_mesh,
+                                              replicated_sharding)
+
+    x = DistributedArray.to_dist(rng.standard_normal(512)
+                                 .astype(np.float32))
+
+    def replicate(v):
+        # force full replication of the sharded operand
+        return jax.lax.with_sharding_constraint(
+            v.array, replicated_sharding(default_mesh())) * 2.0
+
+    with pytest.raises(AssertionError, match="replicated"):
+        assert_no_full_gather(replicate, x, max_fraction=0.5)
+
+
+def test_todense_matches_probe(rng):
+    """Op.todense() equals the probed dense matrix and powers the same
+    oracle the fuzz suite uses."""
+    from pylops_mpi_tpu import MPIBlockDiag, MPIFirstDerivative
+    from pylops_mpi_tpu.ops.local import MatrixMult
+    import scipy.linalg as spla
+    mats = [rng.standard_normal((3, 2)) for _ in range(8)]
+    B = MPIBlockDiag([MatrixMult(m, dtype=np.float64) for m in mats])
+    np.testing.assert_allclose(B.todense(), spla.block_diag(*mats),
+                               rtol=1e-14)
+    # composition: dense of (B.H @ B) is the normal-equations matrix
+    N = B.H @ B
+    Dn = spla.block_diag(*mats).T @ spla.block_diag(*mats)
+    np.testing.assert_allclose(N.todense(), Dn, rtol=1e-12, atol=1e-14)
+
+
+def test_parse_hlo_async_collectives():
+    """TPU lowering emits async -start/-done pairs with tuple result
+    types; the parser must count each pair once with the gathered-buffer
+    bytes (regression: sync-only regex returned {} on TPU HLO)."""
+    from pylops_mpi_tpu.utils.hlo import parse_hlo_collectives
+    hlo = """
+HloModule m
+  %ag-start = (f32[64]{0}, f32[512]{0}) all-gather-start(f32[64]{0} %p0), replica_groups={}
+  %ag-done = f32[512]{0} all-gather-done((f32[64]{0}, f32[512]{0}) %ag-start)
+  %cp-start = (f32[8]{0}, f32[8]{0}) collective-permute-start(f32[8]{0} %p1)
+  %cp-done = f32[8]{0} collective-permute-done((f32[8]{0}, f32[8]{0}) %cp-start)
+  %ar = f64[16]{0} all-reduce(f64[16]{0} %p2), to_apply=%add
+"""
+    rep = parse_hlo_collectives(hlo)
+    assert rep["all-gather"]["count"] == 1          # start counted, done not
+    assert rep["all-gather"]["bytes"] == 512 * 4    # the gathered buffer
+    assert rep["collective-permute"]["count"] == 1
+    assert rep["all-reduce"] == {"count": 1, "bytes": 16 * 8}
+
+
+def test_assert_no_full_gather_kwargs_and_unsized(rng):
+    """kwargs inputs are sized; un-sizable inputs raise instead of
+    passing vacuously."""
+    from pylops_mpi_tpu import DistributedArray
+    from pylops_mpi_tpu.utils import assert_no_full_gather
+    x = DistributedArray.to_dist(rng.standard_normal(64)
+                                 .astype(np.float32))
+    rep = assert_no_full_gather(lambda *, v: v.array * 2.0, v=x)
+    assert "all-gather" not in rep
+    with pytest.raises(ValueError, match="could not size"):
+        assert_no_full_gather(lambda: x.array * 2.0)
+
+
+def test_todense_on_summa_submesh(rng):
+    """todense honours Op.mesh (regression: probes were committed to the
+    default mesh even for operators on a sub-mesh)."""
+    import pylops_mpi_tpu as pmt
+    from pylops_mpi_tpu.basicoperators import active_grid_comm
+    mesh, grid, active, _ = active_grid_comm(16, 16, n_devices=8)
+    A = rng.standard_normal((6, 5)).astype(np.float64)
+    Mop = pmt.MPIMatrixMult(A, M=4, kind="summa", mesh=mesh, grid=grid,
+                            dtype=np.float64)
+    # y.reshape(6,4) == A @ x.reshape(5,4) with C-order ravels, so the
+    # flat operator matrix is kron(A, I_M)
+    np.testing.assert_allclose(Mop.todense(), np.kron(A, np.eye(4)),
+                               rtol=1e-10, atol=1e-12)
