@@ -164,6 +164,14 @@ class MPILinearOperator:
     def __sub__(self, x):
         return self.__add__(-x)
 
+    def checkpointed(self) -> "MPILinearOperator":
+        """Wrap matvec/rmatvec in :func:`jax.checkpoint` (remat): under
+        reverse-mode AD the operator's intermediates are recomputed in
+        the backward pass instead of stored — the standard
+        FLOPs-for-HBM trade for long composed chains whose activation
+        memory would not fit. No effect outside AD."""
+        return _CheckpointedLinearOperator(self)
+
     def todense(self) -> np.ndarray:
         """Dense matrix of the operator, by applying it to each identity
         column and gathering (serial-pylops convenience; the MPI
@@ -333,6 +341,38 @@ class _ConjLinearOperator(MPILinearOperator):
 
     def _adjoint(self):
         return _ConjLinearOperator(self.A.H)
+
+
+class _CheckpointedLinearOperator(MPILinearOperator):
+    """Remat wrapper: matvec/rmatvec run under :func:`jax.checkpoint` so
+    reverse-mode AD recomputes their intermediates instead of storing
+    them (TPU HBM lever for long composed chains)."""
+
+    # layout metadata forwarded so dottest/todense/solvers see the same
+    # shard layout on the wrapper as on the wrapped operator
+    _FORWARDED = ("dims", "dimsd", "mesh", "local_shapes_m",
+                  "local_shapes_n", "local_dim_sizes",
+                  "local_extent_sizes")
+
+    def __init__(self, A: MPILinearOperator):
+        import jax
+        self.A = A
+        for attr in self._FORWARDED:
+            if hasattr(A, attr):
+                setattr(self, attr, getattr(A, attr))
+        super().__init__(shape=A.shape, dtype=A.dtype)
+        self.args = (A,)
+        self._mv = jax.checkpoint(A.matvec)
+        self._rmv = jax.checkpoint(A.rmatvec)
+
+    def _matvec(self, x):
+        return self._mv(x)
+
+    def _rmatvec(self, x):
+        return self._rmv(x)
+
+    def _adjoint(self):
+        return _CheckpointedLinearOperator(self.A.H)
 
 
 def _get_dtype(operators, dtypes=None):

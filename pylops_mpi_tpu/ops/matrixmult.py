@@ -119,7 +119,8 @@ def _pad_to(x: jax.Array, rows: int, cols: int) -> jax.Array:
 
 
 class _MatMulBase(MPILinearOperator):
-    def __init__(self, A, M: int, mesh=None, dtype=None, saveAt: bool = False):
+    def __init__(self, A, M: int, mesh=None, dtype=None, saveAt: bool = False,
+                 compute_dtype=None):
         A = jnp.asarray(A, dtype=dtype)
         self.N, self.K = A.shape
         self.M = int(M)
@@ -129,10 +130,33 @@ class _MatMulBase(MPILinearOperator):
         self.dimsd = (self.N, self.M)
         super().__init__(shape=(self.N * self.M, self.K * self.M),
                          dtype=dtype or A.dtype)
+        # bf16 tile storage with f32 MXU accumulation (same lever as
+        # MPIBlockDiag's compute_dtype): halves the HBM traffic of the
+        # bandwidth-bound matvec on TPU. Real f32 operators only.
+        if compute_dtype is not None and np.dtype(self.dtype) != np.float32:
+            raise ValueError(
+                "compute_dtype is only supported for real float32 "
+                f"operators, dtype is {self.dtype}")
+        self.compute_dtype = compute_dtype
         self.A = self._place_A(A)
         # adjoint reuses conj(A) tiles on the fly unless saveAt
-        # (ref MatrixMult.py:288-292)
-        self.At = jnp.conj(A).T if saveAt else None
+        # (ref MatrixMult.py:288-292); stored at compute_dtype so the
+        # saveAt copy gets the same storage/cast savings
+        self.At = None
+        if saveAt:
+            At = jnp.conj(A).T
+            self.At = At.astype(compute_dtype) if compute_dtype is not None \
+                else At
+
+    def _gemm(self, a, b):
+        """Local GEMM honouring compute_dtype: cast operands down,
+        accumulate in f32 on the MXU, return at the operator dtype."""
+        if self.compute_dtype is None:
+            return a @ b
+        out = jnp.matmul(a.astype(self.compute_dtype),
+                         b.astype(self.compute_dtype),
+                         preferred_element_type=jnp.float32)
+        return out.astype(self.dtype)
 
     def _place_A(self, A):
         return A
@@ -153,6 +177,8 @@ class _MPIBlockMatrixMult(_MatMulBase):
 
     def _place_A(self, A):
         from ..parallel.mesh import axis_sharding
+        if self.compute_dtype is not None:
+            A = A.astype(self.compute_dtype)
         try:
             return jax.device_put(A, axis_sharding(self.mesh, 2, 0))
         except ValueError:
@@ -160,13 +186,13 @@ class _MPIBlockMatrixMult(_MatMulBase):
 
     def _matvec(self, x: DistributedArray) -> DistributedArray:
         X = x.array.reshape(self.K, self.M)
-        Y = self.A @ X                      # (N, M) row-sharded
+        Y = self._gemm(self.A, X)           # (N, M) row-sharded
         return self._wrap_out(Y, x, self.N)
 
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
         Y = x.array.reshape(self.N, self.M)
         At = self.At if self.At is not None else jnp.conj(self.A).T
-        X = At @ Y                          # contraction over sharded N → psum
+        X = self._gemm(At, Y)               # sharded-N contraction → psum
         return self._wrap_out(X, x, self.K)
 
 
@@ -175,12 +201,13 @@ class _MPISummaMatrixMult(_MatMulBase):
     shard_map kernel over an (r, c) mesh."""
 
     def __init__(self, A, M: int, mesh=None, dtype=None, saveAt: bool = False,
-                 grid: Optional[Tuple[int, int]] = None):
+                 grid: Optional[Tuple[int, int]] = None, compute_dtype=None):
         base = mesh if mesh is not None else default_mesh()
         ndev = int(base.devices.size)
         self.grid = grid if grid is not None else best_grid_2d(ndev)
         self.mesh2 = Mesh(base.devices.reshape(self.grid), ("r", "c"))
-        super().__init__(A, M, mesh=base, dtype=dtype, saveAt=saveAt)
+        super().__init__(A, M, mesh=base, dtype=dtype, saveAt=saveAt,
+                         compute_dtype=compute_dtype)
         pr, pc = self.grid
         # padded tile sizes (ref pads to grid multiples, MatrixMult.py:589-601)
         self.Np = pr * int(np.ceil(self.N / pr))
@@ -189,10 +216,14 @@ class _MPISummaMatrixMult(_MatMulBase):
         self.Mp = pc * int(np.ceil(self.M / pc))
         # pad + tile A once, eagerly, and commit it to the 2-D mesh:
         # padding inside the traced apply would make XLA constant-fold a
-        # full copy of A at compile time (very slow for large A)
+        # full copy of A at compile time (very slow for large A). Stored
+        # at compute_dtype when set — bf16 tiles also halve the
+        # all-gather bytes on the wire, not just HBM reads.
+        Ap = _pad_to(jnp.asarray(self.A), self.Np, self.Kp_c)
+        if compute_dtype is not None:
+            Ap = Ap.astype(compute_dtype)
         self.Ap = jax.device_put(
-            _pad_to(jnp.asarray(self.A), self.Np, self.Kp_c),
-            NamedSharding(self.mesh2, P("r", "c")))
+            Ap, NamedSharding(self.mesh2, P("r", "c")))
 
     def _place_A(self, A):
         return A  # logical A kept for todense/debug; Ap is the hot copy
@@ -200,9 +231,11 @@ class _MPISummaMatrixMult(_MatMulBase):
     def _kernel_fwd(self, Ablk, Xblk):
         # Ablk: (Np/pr, Kp_c/pc) tile; Xblk: (Kp_r... ) — gather full
         # row of A along 'c' and full column of X along 'r', one GEMM.
+        if self.compute_dtype is not None:      # gather at the narrow
+            Xblk = Xblk.astype(self.compute_dtype)  # dtype: fewer bytes
         Arow = lax.all_gather(Ablk, "c", axis=1, tiled=True)   # (Np/pr, Kp_c)
         Xcol = lax.all_gather(Xblk, "r", axis=0, tiled=True)   # (Kp_r, Mp/pc)
-        return Arow[:, :self.K] @ Xcol[:self.K]
+        return self._gemm(Arow[:, :self.K], Xcol[:self.K])
 
     def _kernel_adj(self, Ablk, Yblk):
         # X = Aᴴ Y, contraction over N which is sharded on 'r': gather Y
@@ -210,8 +243,10 @@ class _MPISummaMatrixMult(_MatMulBase):
         # against the owned A tile, then psum the partial K-block over
         # 'r'. The reference's tagged-p2p Aᴴ pipeline (ref
         # MatrixMult.py:744-761) becomes gather + reduce.
+        if self.compute_dtype is not None:
+            Yblk = Yblk.astype(self.compute_dtype)
         Yrow = lax.all_gather(Yblk, "c", axis=1, tiled=True)   # (Np/pr, Mp)
-        part = jnp.conj(Ablk).T @ Yrow                         # (Kp_c/pc, Mp)
+        part = self._gemm(jnp.conj(Ablk).T, Yrow)              # (Kp_c/pc, Mp)
         return lax.psum(part, "r")
 
     def _matvec(self, x: DistributedArray) -> DistributedArray:
@@ -236,13 +271,16 @@ class _MPIAutoMatrixMult(_MatMulBase):
     SUMMA')."""
 
     def __init__(self, A, M: int, mesh=None, dtype=None, saveAt: bool = False,
-                 grid: Optional[Tuple[int, int]] = None):
+                 grid: Optional[Tuple[int, int]] = None, compute_dtype=None):
         base = mesh if mesh is not None else default_mesh()
         self.grid = grid if grid is not None else best_grid_2d(int(base.devices.size))
         self.mesh2 = Mesh(base.devices.reshape(self.grid), ("r", "c"))
-        super().__init__(A, M, mesh=base, dtype=dtype, saveAt=saveAt)
+        super().__init__(A, M, mesh=base, dtype=dtype, saveAt=saveAt,
+                         compute_dtype=compute_dtype)
 
     def _place_A(self, A):
+        if self.compute_dtype is not None:
+            A = A.astype(self.compute_dtype)
         try:
             return jax.device_put(A, NamedSharding(self.mesh2, P("r", "c")))
         except ValueError:
@@ -250,31 +288,38 @@ class _MPIAutoMatrixMult(_MatMulBase):
 
     def _matvec(self, x: DistributedArray) -> DistributedArray:
         X = x.array.reshape(self.K, self.M)
-        Y = jnp.einsum("nk,km->nm", self.A, X)
+        Y = self._gemm(self.A, X)
         return self._wrap_out(Y, x, self.N)
 
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
         Y = x.array.reshape(self.N, self.M)
         At = self.At if self.At is not None else jnp.conj(self.A).T
-        X = jnp.einsum("kn,nm->km", At, Y)
+        X = self._gemm(At, Y)
         return self._wrap_out(X, x, self.K)
 
 
 def MPIMatrixMult(A, M: int, saveAt: bool = False, mesh=None,
                   kind: str = "summa", dtype=None,
-                  grid: Optional[Tuple[int, int]] = None) -> MPILinearOperator:
+                  grid: Optional[Tuple[int, int]] = None,
+                  compute_dtype=None) -> MPILinearOperator:
     """Factory (ref ``MatrixMult.py:768-872``): ``kind`` in
     {"block", "summa", "auto"}.
 
     Parameters mirror the reference, except ``A`` is the full global
-    matrix (one controller) rather than this rank's block.
+    matrix (one controller) rather than this rank's block, and
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``) selects low-precision tile
+    storage with f32 MXU accumulation — the TPU bandwidth lever, same as
+    ``MPIBlockDiag(compute_dtype=...)``.
     """
     if kind == "block":
-        return _MPIBlockMatrixMult(A, M, mesh=mesh, dtype=dtype, saveAt=saveAt)
+        return _MPIBlockMatrixMult(A, M, mesh=mesh, dtype=dtype,
+                                   saveAt=saveAt, compute_dtype=compute_dtype)
     if kind == "summa":
         return _MPISummaMatrixMult(A, M, mesh=mesh, dtype=dtype,
-                                   saveAt=saveAt, grid=grid)
+                                   saveAt=saveAt, grid=grid,
+                                   compute_dtype=compute_dtype)
     if kind == "auto":
         return _MPIAutoMatrixMult(A, M, mesh=mesh, dtype=dtype,
-                                  saveAt=saveAt, grid=grid)
+                                  saveAt=saveAt, grid=grid,
+                                  compute_dtype=compute_dtype)
     raise NotImplementedError("kind must be 'block', 'summa' or 'auto'")

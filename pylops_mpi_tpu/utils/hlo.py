@@ -70,7 +70,9 @@ def collective_report(fn, *args, **kwargs) -> Dict[str, Dict[str, int]]:
 
 def parse_hlo_collectives(hlo: str) -> Dict[str, Dict[str, int]]:
     """Tally collectives in HLO text (exposed for direct testing against
-    TPU-style async lowerings without TPU hardware)."""
+    TPU-style async lowerings without TPU hardware). Per kind:
+    ``count``, total ``bytes`` moved, and ``max_bytes`` of any single
+    instruction (variadic/combined ops sum their result buffers)."""
     report: Dict[str, Dict[str, int]] = {}
     for line in hlo.splitlines():
         m = _OP_RE.search(line)
@@ -79,14 +81,24 @@ def parse_hlo_collectives(hlo: str) -> Dict[str, Dict[str, int]]:
         # result type(s) sit between "=" and the opcode:
         #   %y = f32[512]{0} all-gather(...)                     (sync)
         #   %s = (f32[64], f32[512]) all-gather-start(...)       (async)
+        # An async start's tuple also carries the OPERAND shapes, which
+        # reappear as the call arguments — subtract those so only the
+        # produced buffers are counted. Sync (possibly variadic
+        # combined) ops list only results on the left.
         seg = line[:m.start()]
         if "=" in seg:
             seg = seg.split("=", 1)[1]
-        sizes = [_shape_bytes(dt, dims)
-                 for dt, dims in _TYPE_RE.findall(seg)]
-        ent = report.setdefault(m.group(1), {"count": 0, "bytes": 0})
+        nbytes = sum(_shape_bytes(dt, dims)
+                     for dt, dims in _TYPE_RE.findall(seg))
+        if m.group(2):  # "-start"
+            nbytes -= sum(_shape_bytes(dt, dims)
+                          for dt, dims in _TYPE_RE.findall(line[m.end():]))
+        nbytes = max(nbytes, 0)
+        ent = report.setdefault(m.group(1),
+                                {"count": 0, "bytes": 0, "max_bytes": 0})
         ent["count"] += 1
-        ent["bytes"] += max(sizes, default=0)
+        ent["bytes"] += nbytes
+        ent["max_bytes"] = max(ent["max_bytes"], nbytes)
     return report
 
 
@@ -104,9 +116,9 @@ def assert_no_full_gather(fn, *args, max_fraction: float = 0.5, **kwargs):
             "closed-over values")
     limit = max_fraction * in_bytes
     ag = report.get("all-gather")
-    if ag and ag["bytes"] > limit:
+    if ag and ag["max_bytes"] > limit:
         raise AssertionError(
-            f"program all-gathers {ag['bytes']} bytes "
-            f"(> {max_fraction:.0%} of the {in_bytes}-byte "
+            f"program contains an all-gather producing {ag['max_bytes']} "
+            f"bytes (> {max_fraction:.0%} of the {in_bytes}-byte "
             f"largest input): a sharded operand is being replicated")
     return report

@@ -174,3 +174,59 @@ def test_grid_helpers():
 def test_local_block_split_errors():
     with pytest.raises(ValueError):
         local_block_split((10, 8), 99, (2, 2))
+
+
+@pytest.mark.parametrize("kind", ["block", "summa", "auto"])
+def test_matrixmult_compute_dtype(rng, kind):
+    """bf16 tile storage with f32 accumulation stays within bf16
+    tolerance of the f32 result on every variant (the TPU HBM/wire
+    bandwidth lever; MXU accumulates in f32)."""
+    import jax.numpy as jnp
+    N, K, M = 24, 16, 8
+    A = rng.standard_normal((N, K)).astype(np.float32)
+    X = rng.standard_normal((K, M)).astype(np.float32)
+    ref = MPIMatrixMult(A, M=M, kind=kind, dtype=np.float32)
+    lo = MPIMatrixMult(A, M=M, kind=kind, dtype=np.float32,
+                       compute_dtype=jnp.bfloat16)
+    xd = DistributedArray.to_dist(X.ravel())
+    yr = np.asarray(ref.matvec(xd).asarray())
+    yl = np.asarray(lo.matvec(xd).asarray())
+    assert yl.dtype == np.float32           # accumulation/output in f32
+    np.testing.assert_allclose(yl, yr, rtol=2e-2, atol=2e-2)
+    yd = DistributedArray.to_dist(
+        rng.standard_normal(N * M).astype(np.float32))
+    zr = np.asarray(ref.rmatvec(yd).asarray())
+    zl = np.asarray(lo.rmatvec(yd).asarray())
+    np.testing.assert_allclose(zl, zr, rtol=2e-2, atol=2e-2)
+
+
+def test_matrixmult_compute_dtype_rejects_complex(rng):
+    import jax.numpy as jnp
+    A = (rng.standard_normal((8, 8))
+         + 1j * rng.standard_normal((8, 8))).astype(np.complex64)
+    with pytest.raises(ValueError, match="compute_dtype"):
+        MPIMatrixMult(A, M=4, kind="summa", dtype=np.complex64,
+                      compute_dtype=jnp.bfloat16)
+    with pytest.raises(ValueError, match="compute_dtype"):
+        MPIMatrixMult(np.eye(8), M=4, kind="block", dtype=np.float64,
+                      compute_dtype=jnp.bfloat16)
+    # anything other than f32 is rejected, incl. narrower float16
+    with pytest.raises(ValueError, match="compute_dtype"):
+        MPIMatrixMult(np.eye(8, dtype=np.float16), M=4, kind="block",
+                      dtype=np.float16, compute_dtype=jnp.bfloat16)
+
+
+def test_matrixmult_compute_dtype_saveAt_storage(rng):
+    """saveAt + compute_dtype stores the adjoint copy at the narrow
+    dtype too (the storage saving is the point of the option)."""
+    import jax.numpy as jnp
+    A = rng.standard_normal((16, 8)).astype(np.float32)
+    Op = MPIMatrixMult(A, M=4, kind="block", dtype=np.float32,
+                       saveAt=True, compute_dtype=jnp.bfloat16)
+    assert Op.At.dtype == jnp.bfloat16
+    yd = DistributedArray.to_dist(
+        rng.standard_normal(16 * 4).astype(np.float32))
+    z = np.asarray(Op.rmatvec(yd).asarray())
+    np.testing.assert_allclose(
+        z.reshape(8, 4), A.T @ np.asarray(yd.asarray()).reshape(16, 4),
+        rtol=3e-2, atol=3e-2)

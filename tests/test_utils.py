@@ -261,12 +261,18 @@ HloModule m
   %cp-start = (f32[8]{0}, f32[8]{0}) collective-permute-start(f32[8]{0} %p1)
   %cp-done = f32[8]{0} collective-permute-done((f32[8]{0}, f32[8]{0}) %cp-start)
   %ar = f64[16]{0} all-reduce(f64[16]{0} %p2), to_apply=%add
+  %agc = (f32[512]{0}, f32[256]{0}) all-gather(f32[64]{0} %a, f32[32]{0} %b)
 """
     rep = parse_hlo_collectives(hlo)
-    assert rep["all-gather"]["count"] == 1          # start counted, done not
-    assert rep["all-gather"]["bytes"] == 512 * 4    # the gathered buffer
-    assert rep["collective-permute"]["count"] == 1
-    assert rep["all-reduce"] == {"count": 1, "bytes": 16 * 8}
+    # async pair counted once with only the produced buffer's bytes;
+    # the sync variadic (combined) gather sums BOTH result buffers
+    assert rep["all-gather"]["count"] == 2
+    assert rep["all-gather"]["bytes"] == 512 * 4 + (512 + 256) * 4
+    assert rep["all-gather"]["max_bytes"] == (512 + 256) * 4
+    assert rep["collective-permute"] == {"count": 1, "bytes": 8 * 4,
+                                         "max_bytes": 8 * 4}
+    assert rep["all-reduce"] == {"count": 1, "bytes": 16 * 8,
+                                 "max_bytes": 16 * 8}
 
 
 def test_assert_no_full_gather_kwargs_and_unsized(rng):
