@@ -119,6 +119,8 @@ def _pad_to(x: jax.Array, rows: int, cols: int) -> jax.Array:
 
 
 class _MatMulBase(MPILinearOperator):
+    _uses_At = True   # SUMMA adjoint runs on sharded Ap tiles instead
+
     def __init__(self, A, M: int, mesh=None, dtype=None, saveAt: bool = False,
                  compute_dtype=None):
         A = jnp.asarray(A, dtype=dtype)
@@ -141,9 +143,12 @@ class _MatMulBase(MPILinearOperator):
         self.A = self._place_A(A)
         # adjoint reuses conj(A) tiles on the fly unless saveAt
         # (ref MatrixMult.py:288-292); stored at compute_dtype so the
-        # saveAt copy gets the same storage/cast savings
+        # saveAt copy gets the same storage/cast savings. The SUMMA
+        # variant's adjoint kernel works on its sharded Ap tiles and
+        # never reads At — it sets _uses_At = False so no dead K×N
+        # copy is allocated.
         self.At = None
-        if saveAt:
+        if saveAt and self._uses_At:
             At = jnp.conj(A).T
             self.At = At.astype(compute_dtype) if compute_dtype is not None \
                 else At
@@ -199,6 +204,8 @@ class _MPIBlockMatrixMult(_MatMulBase):
 class _MPISummaMatrixMult(_MatMulBase):
     """2-D SUMMA variant (ref ``MatrixMult.py:430-765``) as an explicit
     shard_map kernel over an (r, c) mesh."""
+
+    _uses_At = False
 
     def __init__(self, A, M: int, mesh=None, dtype=None, saveAt: bool = False,
                  grid: Optional[Tuple[int, int]] = None, compute_dtype=None):

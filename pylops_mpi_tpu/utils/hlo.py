@@ -88,11 +88,18 @@ def parse_hlo_collectives(hlo: str) -> Dict[str, Dict[str, int]]:
         seg = line[:m.start()]
         if "=" in seg:
             seg = seg.split("=", 1)[1]
-        nbytes = sum(_shape_bytes(dt, dims)
-                     for dt, dims in _TYPE_RE.findall(seg))
+        lhs = [_shape_bytes(dt, dims)
+               for dt, dims in _TYPE_RE.findall(seg)]
+        nbytes = sum(lhs)
         if m.group(2):  # "-start"
-            nbytes -= sum(_shape_bytes(dt, dims)
-                          for dt, dims in _TYPE_RE.findall(line[m.end():]))
+            # all-gather/permute starts carry (operands..., results...)
+            # in their tuple — subtract the operand echoes. all-reduce
+            # starts carry results only (result shape == operand shape),
+            # recognizable by the lhs having no extra entries.
+            rhs = [_shape_bytes(dt, dims)
+                   for dt, dims in _TYPE_RE.findall(line[m.end():])]
+            if len(lhs) > len(rhs):
+                nbytes -= sum(rhs)
         nbytes = max(nbytes, 0)
         ent = report.setdefault(m.group(1),
                                 {"count": 0, "bytes": 0, "max_bytes": 0})

@@ -301,3 +301,19 @@ def test_todense_on_summa_submesh(rng):
     # flat operator matrix is kron(A, I_M)
     np.testing.assert_allclose(Mop.todense(), np.kron(A, np.eye(4)),
                                rtol=1e-10, atol=1e-12)
+
+
+def test_parse_hlo_async_allreduce_bytes():
+    """all-reduce-start carries the result shape only (no operand
+    echoes in a tuple) — its bytes must not be cancelled by the
+    operand subtraction used for gather/permute starts."""
+    from pylops_mpi_tpu.utils.hlo import parse_hlo_collectives
+    hlo = """
+  %ars = f32[1024]{0} all-reduce-start(f32[1024]{0} %p0), to_apply=%add
+  %ard = f32[1024]{0} all-reduce-done(f32[1024]{0} %ars)
+  %carс = (f32[16]{0}, f32[8]{0}) all-reduce-start(f32[16]{0} %a, f32[8]{0} %b), to_apply=%add
+"""
+    rep = parse_hlo_collectives(hlo)
+    assert rep["all-reduce"]["count"] == 2
+    assert rep["all-reduce"]["bytes"] == 1024 * 4 + (16 + 8) * 4
+    assert rep["all-reduce"]["max_bytes"] == 1024 * 4
