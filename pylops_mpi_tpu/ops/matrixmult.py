@@ -119,7 +119,9 @@ def _pad_to(x: jax.Array, rows: int, cols: int) -> jax.Array:
 
 
 class _MatMulBase(MPILinearOperator):
-    _uses_At = True   # SUMMA adjoint runs on sharded Ap tiles instead
+    # subclasses whose adjoint never reads At set this False
+    # (see _MPISummaMatrixMult: its kernels use the sharded Ap tiles)
+    _uses_At = True
 
     def __init__(self, A, M: int, mesh=None, dtype=None, saveAt: bool = False,
                  compute_dtype=None):

@@ -33,6 +33,30 @@ class StackedDistributedArray:
     def __setitem__(self, index, value):
         self.distarrays[index] = value
 
+    @property
+    def global_shape(self):
+        """Elementwise sum of component global shapes — the reference's
+        (ref ``DistributedArray.py:1000-1035``) convention for nested
+        stacking. Defined only when every component has the same rank;
+        mixed-rank stacks raise (use ``size`` for the flat element
+        count)."""
+        if not self.distarrays:
+            raise ValueError("global_shape of an empty stack is undefined")
+        gs = self.distarrays[0].global_shape
+        for d in self.distarrays[1:]:
+            ds = d.global_shape
+            if len(ds) != len(gs):
+                raise ValueError(
+                    "global_shape requires equal-rank components, got "
+                    f"{len(gs)}-d and {len(ds)}-d; use .size instead")
+            gs = tuple(a + b for a, b in zip(gs, ds))
+        return gs
+
+    @property
+    def size(self) -> int:
+        """Total number of elements across components (incl. nested)."""
+        return int(sum(d.size for d in self.distarrays))
+
     def asarray(self) -> np.ndarray:
         """Global gather: concatenation of flattened components
         (ref ``DistributedArray.py:1196-1214``)."""

@@ -284,3 +284,54 @@ def test_stacked_array_mismatch_raises(rng):
         DistributedArray.to_dist(rng.standard_normal(8))])
     with pytest.raises(ValueError):
         s + t
+
+
+def test_stacked_nested(rng):
+    """Nested stacks (a StackedDistributedArray containing another) keep
+    full vector-space semantics (ref tests/test_stackedarray.py:212-328:
+    creation, asarray, math, dot, norm over nested stacks)."""
+    a = rng.standard_normal(16)
+    b = rng.standard_normal(24)
+    c = rng.standard_normal((4, 6))
+    inner = StackedDistributedArray([DistributedArray.to_dist(a),
+                                     DistributedArray.to_dist(b)])
+    nest = StackedDistributedArray([inner, DistributedArray.to_dist(c)])
+    full = np.concatenate([a, b, c.ravel()])
+    np.testing.assert_allclose(nest.asarray(), full, rtol=1e-14)
+    np.testing.assert_allclose((nest + nest).asarray(), 2 * full,
+                               rtol=1e-14)
+    np.testing.assert_allclose((nest * nest).asarray(), full ** 2,
+                               rtol=1e-14)
+    np.testing.assert_allclose(float(nest.norm(2)),
+                               np.linalg.norm(full), rtol=1e-12)
+    np.testing.assert_allclose(float(nest.dot(nest)), full @ full,
+                               rtol=1e-12)
+    assert nest.size == full.size
+    # in-place mutation of a component is visible through the stack
+    # (the stack holds references, ref test_stackedarray.py:255-263)
+    arr0 = nest[0][0]
+    arr0[:] = 2 * np.ones(16)
+    np.testing.assert_allclose(nest.asarray()[:16], 2.0, rtol=1e-14)
+
+
+def test_stacked_global_shape_convention(rng):
+    """global_shape sums component shapes elementwise (the reference's
+    nesting convention, ref DistributedArray.py:1000-1035)."""
+    s = StackedDistributedArray(
+        [DistributedArray.to_dist(rng.standard_normal((8, 4))),
+         DistributedArray.to_dist(rng.standard_normal((8, 4)))])
+    assert s.global_shape == (16, 8)
+    nest = StackedDistributedArray(
+        [s, DistributedArray.to_dist(rng.standard_normal((16, 8)))])
+    assert nest.global_shape == (32, 16)
+
+
+def test_stacked_global_shape_mixed_rank_raises(rng):
+    """Mixed-rank stacks have no well-defined global_shape — raise
+    instead of zip-truncating to a plausible-but-wrong tuple."""
+    s = StackedDistributedArray(
+        [DistributedArray.to_dist(rng.standard_normal(16)),
+         DistributedArray.to_dist(rng.standard_normal((4, 6)))])
+    with pytest.raises(ValueError, match="equal-rank"):
+        s.global_shape
+    assert s.size == 40

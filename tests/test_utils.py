@@ -311,7 +311,7 @@ def test_parse_hlo_async_allreduce_bytes():
     hlo = """
   %ars = f32[1024]{0} all-reduce-start(f32[1024]{0} %p0), to_apply=%add
   %ard = f32[1024]{0} all-reduce-done(f32[1024]{0} %ars)
-  %carс = (f32[16]{0}, f32[8]{0}) all-reduce-start(f32[16]{0} %a, f32[8]{0} %b), to_apply=%add
+  %carc = (f32[16]{0}, f32[8]{0}) all-reduce-start(f32[16]{0} %a, f32[8]{0} %b), to_apply=%add
 """
     rep = parse_hlo_collectives(hlo)
     assert rep["all-reduce"]["count"] == 2
