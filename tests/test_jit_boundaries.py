@@ -160,3 +160,35 @@ def test_ragged_vectors_through_fused_solver(rng):
     fn = jax.jit(lambda y, x: _cg_fused(Op, y, x, 120, 1e-13)[0])
     got = fn(dy, dy.zeros_like())
     np.testing.assert_allclose(got.asarray(), xtrue, rtol=1e-6, atol=1e-8)
+
+
+def test_fused_cgls_collective_schedule_is_scalar_only(rng):
+    """The flagship fused CGLS program's ONLY collectives are a handful
+    of scalar all-reduces (the psum'd solver scalars): no all-gather, no
+    per-iteration data movement — the single-XLA-program redesign win
+    (SURVEY §3.2). Pinned so layout regressions cannot sneak in."""
+    import jax.numpy as jnp
+    from pylops_mpi_tpu import DistributedArray, MPIBlockDiag
+    from pylops_mpi_tpu.ops.local import MatrixMult
+    from pylops_mpi_tpu.solvers.basic import _cgls_fused, _cgls_fused_normal
+    from pylops_mpi_tpu.utils import collective_report
+
+    blocks = [rng.standard_normal((32, 32)).astype(np.float32)
+              for _ in range(8)]
+    y = DistributedArray.to_dist(
+        rng.standard_normal(256).astype(np.float32))
+    for cd, solver in ((None, _cgls_fused), (jnp.bfloat16,
+                                             _cgls_fused_normal)):
+        Op = MPIBlockDiag([MatrixMult(b, dtype=np.float32)
+                           for b in blocks], compute_dtype=cd)
+        if cd is not None and not Op.has_fused_normal:
+            solver = _cgls_fused
+        rep = collective_report(
+            lambda yy, xx: solver(Op, yy, xx, 20, 0.0, 0.0)[0].array,
+            y, y.zeros_like())
+        # NOTHING but scalar all-reduces — any other collective kind
+        # (gather, permute, reduce-scatter, ...) is a layout regression
+        assert set(rep) <= {"all-reduce"}, rep
+        ar = rep.get("all-reduce", {"count": 0, "max_bytes": 0})
+        assert ar["count"] == 3, rep          # the psum'd solver scalars
+        assert ar["max_bytes"] <= 16, rep     # each is one scalar
