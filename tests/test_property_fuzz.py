@@ -116,7 +116,11 @@ def test_hstack_vstack_derivative_mix(seed):
     mats = [rng.standard_normal((n // 8, n // 8)) for _ in range(8)]
     B = MPIBlockDiag([MatrixMult(m, dtype=np.float64) for m in mats])
     Op = B @ D1                     # stencil into blockdiag
-    Dd = _dense_of(D1)
+    # analytic dense centered-3 stencil (zero first/last rows) — probing
+    # the distributed operator here would cost n shard_map dispatches
+    Dd = np.zeros((n, n))
+    for i in range(1, n - 1):
+        Dd[i, i - 1], Dd[i, i + 1] = -0.5, 0.5
     Db = spla.block_diag(*mats)
     x = rng.standard_normal(n)
     y = Op.matvec(DistributedArray.to_dist(x))
