@@ -10,6 +10,14 @@ TPU-native: one batched einsum with ``G`` slice-sharded. XLA shards the
 batch dimension (each device contracts its own frequency batch on the
 MXU) and replicates the result for the BROADCAST output — the same
 gather, scheduled by the partitioner over ICI.
+
+Beyond the reference (SURVEY §7.10): SCATTER model/data are also
+accepted when the slice count divides the mesh. Each device then holds
+only its frequency batch of the model AND the data, the einsum is
+slice-aligned with ``G``'s sharding, and the whole apply contains ZERO
+collectives — 1/P the memory of the reference's replicated-model
+design. Construct the vectors with ``model_local_shapes`` /
+``data_local_shapes``.
 """
 
 from __future__ import annotations
@@ -57,28 +65,61 @@ class MPIFredholm1(MPILinearOperator):
         except ValueError:
             self.G = G
         self.GT = jnp.conj(G.transpose(0, 2, 1)) if saveGt else None
+        self._ndev = int(self.mesh.devices.size)
 
-    def _check_bcast(self, x):
-        if x.partition not in (Partition.BROADCAST, Partition.UNSAFE_BROADCAST):
-            raise ValueError(
-                f"x should have partition={Partition.BROADCAST},"
-                f"{Partition.UNSAFE_BROADCAST} Got {x.partition} instead...")
+    @property
+    def model_local_shapes(self):
+        """Slice-aligned SCATTER split of the flat model vector (the
+        zero-communication layout); None when slices do not divide the
+        mesh."""
+        return self._slice_shapes(self.ny)
 
-    def _matvec(self, x: DistributedArray) -> DistributedArray:
-        self._check_bcast(x)
-        m = x.array.reshape(self.dims).astype(self.dtype)
-        d = jnp.einsum("kxy,kyz->kxz", self.G, m)
-        y = DistributedArray(global_shape=self.shape[0], mesh=x.mesh,
-                             partition=x.partition, dtype=self.dtype)
-        y[:] = d.ravel()
+    @property
+    def data_local_shapes(self):
+        """Slice-aligned SCATTER split of the flat data vector."""
+        return self._slice_shapes(self.nx)
+
+    def _slice_shapes(self, inner):
+        if self.nsl % self._ndev != 0:
+            # must match G's even NamedSharding for the zero-comm path
+            return None
+        from ..parallel.partition import flat_outer_shapes
+        return flat_outer_shapes(self.nsl, inner * self.nz, self._ndev)
+
+    def _check_partition(self, x, inner):
+        if x.partition in (Partition.BROADCAST,
+                           Partition.UNSAFE_BROADCAST):
+            return
+        shapes = self._slice_shapes(inner)
+        if x.partition == Partition.SCATTER and shapes is not None \
+                and tuple(x._axis_sizes) == tuple(s[0] for s in shapes):
+            return
+        raise ValueError(
+            "x must be BROADCAST, or SCATTER with slice-aligned local "
+            "shapes (model_local_shapes/data_local_shapes; requires "
+            f"nsl % n_devices == 0); got {x.partition} with local sizes "
+            f"{tuple(x._axis_sizes)}")
+
+    def _wrap(self, arr, x: DistributedArray, n: int,
+              inner: int) -> DistributedArray:
+        shapes = None
+        if x.partition == Partition.SCATTER:
+            shapes = self._slice_shapes(inner)
+        y = DistributedArray(global_shape=n, mesh=x.mesh,
+                             partition=x.partition, local_shapes=shapes,
+                             dtype=self.dtype)
+        y[:] = arr.ravel()
         return y
 
+    def _matvec(self, x: DistributedArray) -> DistributedArray:
+        self._check_partition(x, self.ny)
+        m = x.array.reshape(self.dims).astype(self.dtype)
+        d = jnp.einsum("kxy,kyz->kxz", self.G, m)
+        return self._wrap(d, x, self.shape[0], self.nx)
+
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
-        self._check_bcast(x)
+        self._check_partition(x, self.nx)
         d = x.array.reshape(self.dimsd).astype(self.dtype)
         GT = self.GT if self.GT is not None else jnp.conj(self.G).transpose(0, 2, 1)
         m = jnp.einsum("kyx,kxz->kyz", GT, d)
-        y = DistributedArray(global_shape=self.shape[1], mesh=x.mesh,
-                             partition=x.partition, dtype=self.dtype)
-        y[:] = m.ravel()
-        return y
+        return self._wrap(m, x, self.shape[1], self.ny)
