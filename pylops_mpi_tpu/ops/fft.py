@@ -117,10 +117,11 @@ class _MPIBaseFFTND(MPILinearOperator):
             self.dims_nd, P, Partition.SCATTER, 0))
         self._rows_d = tuple(s[0] for s in local_split(
             self.dimsd_nd, P, Partition.SCATTER, 0))
+        from ..parallel.partition import flat_outer_shapes
         inner_m = int(np.prod(self.dims_nd[1:])) if ndim > 1 else 1
         inner_d = int(np.prod(self.dimsd_nd[1:])) if ndim > 1 else 1
-        self._mlocals = tuple((r * inner_m,) for r in self._rows_m)
-        self._dlocals = tuple((r * inner_d,) for r in self._rows_d)
+        self._mlocals = flat_outer_shapes(self.dims_nd[0], inner_m, P)
+        self._dlocals = flat_outer_shapes(self.dimsd_nd[0], inner_d, P)
 
     @property
     def model_local_shapes(self):
