@@ -107,9 +107,9 @@ def _bench_fft(pmt, rng, n_dev, scale):
 
 def _bench_fredholm(pmt, rng, n_dev, scale):
     import jax
-    nsl, nx_, ny_ = 8 * n_dev * scale, 64, 64
+    nsl, nx_, ny_, nz_ = 8 * n_dev * scale, 64, 64, 4
     G = rng.standard_normal((nsl, nx_, ny_)).astype(np.float32)
-    Fr = pmt.MPIFredholm1(G, nz=4, dtype=np.float32)
+    Fr = pmt.MPIFredholm1(G, nz=nz_, dtype=np.float32)
     xr = pmt.DistributedArray.to_dist(
         rng.standard_normal(Fr.shape[1]).astype(np.float32),
         partition=pmt.Partition.BROADCAST)
@@ -121,7 +121,7 @@ def _bench_fredholm(pmt, rng, n_dev, scale):
         rng.standard_normal(Fr.shape[1]).astype(np.float32),
         local_shapes=Fr.model_local_shapes)
     dt_s = _timeit(fn, xs, inner=5)  # jit re-specializes per sharding
-    flops = 2 * nsl * nx_ * ny_ * 4
+    flops = 2 * nsl * nx_ * ny_ * nz_
     return {"bench": "fredholm1_batched",
             "value": round(flops / dt / 1e9, 1),
             "unit": "GFLOP/s",

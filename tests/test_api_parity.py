@@ -116,3 +116,46 @@ def test_operator_attr_surface(rng):
                  "adjoint", "transpose", "conj", "H", "T"):
         assert hasattr(Op, attr), attr
     assert Op.shape == (24, 24)
+
+
+def test_complete_reference_symbol_parity():
+    """EVERY public symbol of the reference package resolves here (full
+    sweep of public defs across pylops_mpi/*.py — the L0/L1 MPI/NCCL
+    primitive layer dissolves into XLA collectives, checked via its
+    documented equivalents; ``subcomm_split`` becomes the ``mask=``
+    argument, asserted functionally)."""
+    import pylops_mpi_tpu as pmt
+    top = ["Partition", "local_split", "DistributedArray",
+           "StackedDistributedArray", "MPILinearOperator",
+           "asmpilinearoperator", "MPIStackedLinearOperator",
+           "MPIBlockDiag", "MPIStackedBlockDiag", "MPIFirstDerivative",
+           "MPIGradient", "MPIHStack", "MPIHalo", "halo_block_split",
+           "MPILaplacian", "MPIMatrixMult", "MPISecondDerivative",
+           "MPIVStack", "MPIStackedVStack", "cg", "cgls", "CG", "CGLS",
+           "ISTA", "FISTA", "power_iteration", "ista", "fista",
+           "plot_distributed_array", "plot_local_arrays", "MPIFFT2D",
+           "MPIFFTND", "MPIFredholm1", "MPINonStationaryConvolve1D",
+           "dottest", "MPIMDC"]
+    missing = [n for n in top if not hasattr(pmt, n)]
+    assert not missing, f"missing top-level symbols: {missing}"
+
+    # submodule-level symbols at their reference paths
+    from pylops_mpi_tpu.basicoperators import (active_grid_comm,
+                                               local_block_split,
+                                               block_gather)
+    from pylops_mpi_tpu.utils import (benchmark, fftshift_nd,
+                                      ifftshift_nd)
+    from pylops_mpi_tpu.utils.benchmark import mark
+    from pylops_mpi_tpu.utils.decorators import reshaped
+    from pylops_mpi_tpu.utils import deps
+
+    # the MPI/NCCL primitive layer's XLA-native equivalents
+    from pylops_mpi_tpu.parallel.collectives import (
+        all_to_all_resharding, ring_halo_extend, cart_halo_extend)
+    from pylops_mpi_tpu.parallel.mesh import (make_mesh,
+                                              initialize_multihost)
+
+    # subcomm_split analog: mask= sub-groups reduce independently
+    d = pmt.DistributedArray.to_dist(np.ones(16),
+                                     mask=[0, 0, 0, 0, 1, 1, 1, 1])
+    assert np.asarray(d.dot(d)).shape == (2,)
