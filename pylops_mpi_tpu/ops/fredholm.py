@@ -40,6 +40,10 @@ class MPIFredholm1(MPILinearOperator):
 
     Parameters mirror the reference except ``G`` is the full global
     kernel ``(nsl, nx, ny)`` (one controller), not this rank's chunk.
+    ``usematmul`` is accepted for signature parity but has no effect:
+    it selects between per-slice matmul and einsum execution in the
+    reference (identical results, ref ``Fredholm1.py:120-131``); here
+    the batched einsum on the MXU is always the right schedule.
     """
 
     def __init__(self, G, nz: int = 1, saveGt: bool = False,

@@ -37,6 +37,21 @@ def reshaped(func=None, forward: Optional[bool] = None,
 
         @functools.wraps(f)
         def wrapper(self, x: DistributedArray):
+            if stacking:
+                # stacking operators keep the vector FLAT but rebalanced
+                # to the operator's per-shard layout (local_shapes_m on
+                # the forward side, local_shapes_n on the adjoint side —
+                # ref decorators.py:39-52's ghost-cell rebalancing,
+                # here a logical repack scheduled by XLA)
+                shapes = self.local_shapes_m if fwd else \
+                    self.local_shapes_n
+                nd = DistributedArray(global_shape=x.global_shape,
+                                      mesh=x.mesh,
+                                      partition=Partition.SCATTER,
+                                      axis=0, local_shapes=shapes,
+                                      mask=x.mask, dtype=x.dtype)
+                nd[:] = x.array
+                return f(self, nd)
             dims = self.dims if fwd else self.dimsd
             dims = tuple(int(d) for d in np.atleast_1d(dims))
             nd = DistributedArray(global_shape=dims, mesh=x.mesh,

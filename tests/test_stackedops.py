@@ -335,3 +335,48 @@ def test_stacked_global_shape_mixed_rank_raises(rng):
     with pytest.raises(ValueError, match="equal-rank"):
         s.global_shape
     assert s.size == 40
+
+
+def test_reshaped_stacking_rebalances(rng):
+    """@reshaped(stacking=True) hands the wrapped matvec a FLAT vector
+    rebalanced to the operator's per-shard layout (ref
+    decorators.py:39-52), instead of reshaping to N-D."""
+    from pylops_mpi_tpu.utils.decorators import reshaped
+    from pylops_mpi_tpu import MPILinearOperator
+
+    # DISTINCT m/n layouts (both sum to 48) so a forward/adjoint
+    # shape-selection swap cannot pass undetected
+    sizes_m = [(7,), (7,), (7,), (7,), (5,), (5,), (5,), (5,)]
+    sizes_n = [(5,), (5,), (5,), (5,), (7,), (7,), (7,), (7,)]
+
+    class Probe(MPILinearOperator):
+        def __init__(self):
+            super().__init__(shape=(48, 48), dtype=np.float64)
+            self.local_shapes_m = tuple(sizes_m)
+            self.local_shapes_n = tuple(sizes_n)
+            self.seen = None
+
+        @reshaped(forward=True, stacking=True)
+        def _matvec(self, x):
+            self.seen = tuple(tuple(s) for s in x.local_shapes)
+            return x * 2.0
+
+        @reshaped(forward=False, stacking=True)
+        def _rmatvec(self, x):
+            self.seen = tuple(tuple(s) for s in x.local_shapes)
+            return x * 2.0
+
+    Op = Probe()
+    v = rng.standard_normal(48)
+    # deliberately enter with the default balanced layout (6 each)
+    x = DistributedArray.to_dist(v)
+    assert tuple(tuple(s) for s in x.local_shapes) not in (
+        tuple(sizes_m), tuple(sizes_n))
+    y = Op.matvec(x)
+    assert Op.seen == tuple(sizes_m)        # forward side -> m layout
+    np.testing.assert_allclose(np.asarray(y.asarray()), 2 * v,
+                               rtol=1e-14)
+    z = Op.rmatvec(x)
+    assert Op.seen == tuple(sizes_n)        # adjoint side -> n layout
+    np.testing.assert_allclose(np.asarray(z.asarray()), 2 * v,
+                               rtol=1e-14)
