@@ -25,6 +25,15 @@ from ..distributedarray import DistributedArray, Partition
 __all__ = ["reshaped"]
 
 
+def _flatten_out(y):
+    """Normalize a wrapped function's return to the flat axis-0 vector
+    solvers expect (ref ``decorators.py:79-81`` does this
+    unconditionally)."""
+    if isinstance(y, DistributedArray) and y.ndim > 1:
+        return y.redistribute(0).ravel() if y.axis != 0 else y.ravel()
+    return y
+
+
 def reshaped(func=None, forward: Optional[bool] = None,
              stacking: bool = False):
     """Decorate an ``_matvec``/``_rmatvec`` so it receives an N-D
@@ -51,17 +60,14 @@ def reshaped(func=None, forward: Optional[bool] = None,
                                       axis=0, local_shapes=shapes,
                                       mask=x.mask, dtype=x.dtype)
                 nd[:] = x.array
-                return f(self, nd)
+                return _flatten_out(f(self, nd))
             dims = self.dims if fwd else self.dimsd
             dims = tuple(int(d) for d in np.atleast_1d(dims))
             nd = DistributedArray(global_shape=dims, mesh=x.mesh,
                                   partition=Partition.SCATTER, axis=0,
                                   mask=x.mask, dtype=x.dtype)
             nd[:] = x.array.reshape(dims)
-            y = f(self, nd)
-            if isinstance(y, DistributedArray) and y.ndim > 1:
-                y = y.redistribute(0).ravel() if y.axis != 0 else y.ravel()
-            return y
+            return _flatten_out(f(self, nd))
         return wrapper
 
     if func is not None:

@@ -317,3 +317,19 @@ def test_parse_hlo_async_allreduce_bytes():
     assert rep["all-reduce"]["count"] == 2
     assert rep["all-reduce"]["bytes"] == 1024 * 4 + (16 + 8) * 4
     assert rep["all-reduce"]["max_bytes"] == 1024 * 4
+
+
+def test_profile_trace_writes_artifacts(tmp_path):
+    """profile_trace captures a TensorBoard-compatible jax.profiler
+    trace for the wrapped region (the XLA-level observability layer,
+    SURVEY §5 tracing)."""
+    import jax.numpy as jnp
+    from pylops_mpi_tpu.utils import profile_trace
+    d = str(tmp_path / "trace")
+    with profile_trace(d):
+        x = jnp.arange(64.0)
+        (x * 2).block_until_ready()
+    produced = [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
+    assert produced, "no trace artifacts written"
+    assert any("trace" in f or f.endswith(".pb") or ".xplane." in f
+               for f in produced), produced
