@@ -60,6 +60,20 @@ class _MPIBaseFFTND(MPILinearOperator):
             nffts = tuple(self.dims_nd[ax] for ax in axes)
         self.nffts = _astuple(nffts, len(axes), int)
         self.sampling = _astuple(sampling, len(axes), float)
+        if norm == "backward":
+            # numpy-convention names get the reference's guidance
+            # (ref _baseffts.py:79-84)
+            raise ValueError(
+                'To use no scaling on the forward transform, use "none". '
+                "Note that in this case the adjoint transform will *not* "
+                "have a 1/n scaling.")
+        if norm == "forward":
+            raise ValueError(
+                'To use 1/n scaling on the forward transform, use "1/n". '
+                "Note that in this case the adjoint transform will *also* "
+                "have a 1/n scaling.")
+        if isinstance(norm, str) and norm.lower() == "1/n":
+            norm = "1/n"   # ref accepts any case (_baseffts.py:77)
         if norm not in ("none", "1/n"):
             raise ValueError(f"norm must be 'none' or '1/n', got {norm!r}")
         self.norm = norm

@@ -272,3 +272,26 @@ def test_fftnd_aligned_output_feeds_aligned_input(rng):
     za = Fop.rmatvec(ya)
     np.testing.assert_allclose(za.asarray(), Fop.rmatvec(yd).asarray(),
                                rtol=1e-12)
+
+
+@pytest.mark.parametrize("bad,hint", [("backward", "use \"none\""),
+                                      ("forward", "use \"1/n\""),
+                                      ("ortho", "must be")])
+def test_fftnd_norm_guidance(bad, hint):
+    """numpy-convention norm names are rejected with the reference's
+    guidance toward the pylops names (ref _baseffts.py:79-87)."""
+    with pytest.raises(ValueError, match=hint.replace('"', '.')):
+        MPIFFTND((16, 8), axes=(0, 1), norm=bad, dtype=np.complex128)
+
+
+def test_fftnd_norm_case_insensitive(rng):
+    """'1/N' is accepted case-insensitively like the reference
+    (_baseffts.py:77) and behaves identically to '1/n'."""
+    x = (rng.standard_normal((16, 8))
+         + 1j * rng.standard_normal((16, 8))).astype(np.complex128)
+    a = MPIFFTND((16, 8), axes=(0, 1), norm="1/N", dtype=np.complex128)
+    b = MPIFFTND((16, 8), axes=(0, 1), norm="1/n", dtype=np.complex128)
+    dx = DistributedArray.to_dist(x.ravel())
+    np.testing.assert_allclose(np.asarray(a.matvec(dx).asarray()),
+                               np.asarray(b.matvec(dx).asarray()),
+                               rtol=1e-14)
