@@ -266,13 +266,39 @@ def _run_child(env, timeout):
     return None, f"rc={p.returncode}; stderr tail: {(p.stderr or '')[-1500:]}"
 
 
+def _tpu_probe(timeout: int):
+    """Cheap liveness check: init the accelerator backend in a
+    disposable child. A dead tunnel hangs/errors here in ``timeout``
+    seconds instead of consuming the full measurement budget. Returns
+    ``(ok, detail)`` — the child's stderr tail on failure, so the real
+    init error (lock, dead tunnel, plugin misconfig) stays visible."""
+    code = "import jax; assert jax.default_backend() != 'cpu'"
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           env=dict(os.environ), capture_output=True,
+                           text=True, timeout=timeout)
+        if p.returncode == 0:
+            return True, ""
+        return False, (p.stderr or "")[-600:]
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung (> {timeout}s)"
+    except Exception as e:
+        return False, repr(e)[:300]
+
+
 def main():
     t_tpu = int(os.environ.get("BENCH_TPU_TIMEOUT", "1800"))
     t_cpu = int(os.environ.get("BENCH_CPU_TIMEOUT", "1500"))
+    t_probe = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "300"))
 
     result, err1 = None, "accelerator attempt skipped (JAX_PLATFORMS=cpu)"
     if os.environ.get("JAX_PLATFORMS", "") != "cpu":
-        result, err1 = _run_child(dict(os.environ), t_tpu)
+        ok, detail = _tpu_probe(t_probe)
+        if ok:
+            result, err1 = _run_child(dict(os.environ), t_tpu)
+        else:
+            err1 = (f"TPU probe failed within {t_probe}s: "
+                    f"{detail or 'backend init hung or errored'}")
 
     if result is None:
         env = dict(os.environ)
