@@ -267,23 +267,26 @@ def _run_child(env, timeout):
 
 
 def _tpu_probe(timeout: int):
-    """Cheap liveness check: init the accelerator backend in a
-    disposable child. A dead tunnel hangs/errors here in ``timeout``
-    seconds instead of consuming the full measurement budget. Returns
-    ``(ok, detail)`` — the child's stderr tail on failure, so the real
-    init error (lock, dead tunnel, plugin misconfig) stays visible."""
-    code = "import jax; assert jax.default_backend() != 'cpu'"
+    """Cheap liveness check: init whatever backend is default in a
+    disposable child. A dead TPU tunnel hangs/errors here in
+    ``timeout`` seconds instead of consuming the full measurement
+    budget; the healthy path pays one duplicated backend init (tens of
+    seconds, small against the 1800 s budget it protects). Returns
+    ``(status, detail)``: status is the backend name ("tpu"/"cpu"/...)
+    on success or "dead" with the child's stderr tail, so the real init
+    error (lock, dead tunnel, plugin misconfig) stays visible."""
+    code = "import jax; print(jax.default_backend())"
     try:
         p = subprocess.run([sys.executable, "-c", code],
                            env=dict(os.environ), capture_output=True,
                            text=True, timeout=timeout)
         if p.returncode == 0:
-            return True, ""
-        return False, (p.stderr or "")[-600:]
+            return (p.stdout or "").strip().splitlines()[-1], ""
+        return "dead", (p.stderr or "")[-600:]
     except subprocess.TimeoutExpired:
-        return False, f"probe hung (> {timeout}s)"
+        return "dead", f"probe hung (> {timeout}s)"
     except Exception as e:
-        return False, repr(e)[:300]
+        return "dead", repr(e)[:300]
 
 
 def main():
@@ -293,8 +296,10 @@ def main():
 
     result, err1 = None, "accelerator attempt skipped (JAX_PLATFORMS=cpu)"
     if os.environ.get("JAX_PLATFORMS", "") != "cpu":
-        ok, detail = _tpu_probe(t_probe)
-        if ok:
+        status, detail = _tpu_probe(t_probe)
+        if status != "dead":
+            # any live backend (tpu, or plain cpu on accelerator-less
+            # machines — the pre-probe behavior) gets the first attempt
             result, err1 = _run_child(dict(os.environ), t_tpu)
         else:
             err1 = (f"TPU probe failed within {t_probe}s: "

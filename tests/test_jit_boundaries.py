@@ -192,3 +192,36 @@ def test_fused_cgls_collective_schedule_is_scalar_only(rng):
         ar = rep.get("all-reduce", {"count": 0, "max_bytes": 0})
         assert ar["count"] == 3, rep          # the psum'd solver scalars
         assert ar["max_bytes"] <= 16, rep     # each is one scalar
+
+
+@pytest.mark.parametrize("momentum", [False, True])
+def test_fused_ista_collective_schedule_is_scalar_only(rng, momentum):
+    """The fused ISTA/FISTA program, like fused CGLS, moves no data
+    between shards — its only collectives are the scalar all-reduces of
+    the step/cost/update norms."""
+    import jax.numpy as jnp
+    from pylops_mpi_tpu import DistributedArray, MPIBlockDiag
+    from pylops_mpi_tpu.ops.local import MatrixMult
+    from pylops_mpi_tpu.solvers.sparsity import _ista_fused, _THRESHF
+    from pylops_mpi_tpu.utils import collective_report
+
+    blocks = [rng.standard_normal((16, 16)).astype(np.float32)
+              for _ in range(8)]
+    Op = MPIBlockDiag([MatrixMult(b, dtype=np.float32) for b in blocks])
+    y = DistributedArray.to_dist(
+        rng.standard_normal(128).astype(np.float32))
+
+    def run(yy, xx):
+        return _ista_fused(Op, yy, xx, 0.2, 0.1, 0.0,
+                           jnp.ones(10, dtype=jnp.float32), niter=10,
+                           threshf=_THRESHF["soft"],
+                           momentum=momentum)[0].array
+
+    rep = collective_report(run, y, y.zeros_like())
+    assert set(rep) == {"all-reduce"}, rep
+    ar = rep["all-reduce"]
+    # at least one cross-shard reduction must exist (dropping the psum
+    # entirely would be a different, worse regression), and none may
+    # exceed scalar size
+    assert 1 <= ar["count"] <= 6, rep
+    assert ar["max_bytes"] <= 16, rep
