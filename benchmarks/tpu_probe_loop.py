@@ -1,0 +1,179 @@
+"""TPU-window harvesting daemon.
+
+The remote TPU tunnel ("axon" backend) flakes for hours at a time
+(rounds 1 and 2 both ended with the tunnel down and zero TPU numbers).
+This daemon turns the bench from a one-shot gamble into a
+round-long harvest:
+
+- every ``--interval`` seconds, a *cheap* liveness probe (disposable
+  child, hard timeout) — every attempt is appended to
+  ``tpu_probe_log.jsonl`` with timestamp + status, so the bench
+  artifact can prove how often the tunnel was tried even if it never
+  came up;
+- on any live window, escalate through three stages, persisting each
+  result to ``tpu_cache.json`` *immediately* (atomic replace) so a
+  mid-stage tunnel drop keeps everything already earned:
+
+  1. ``tpu_selfcheck`` — every Pallas kernel + hot path vs oracles
+     (seconds of TPU time; catches Mosaic failures first);
+  2. small flagship — N=1024, 20 iters (seconds);
+  3. full flagship — the default N=4096 headline + components.
+
+``bench.py`` merges the cache and the probe log into its JSON output,
+so the round artifact contains a TPU number if *any* probe during the
+round found the tunnel up.
+
+Run: ``python benchmarks/tpu_probe_loop.py [--interval 180]
+[--max-hours 11] [--once]``. Exits when the full flagship is cached
+(mission complete) or at ``--max-hours``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+# TPU_PROBE_DIR redirects the artifacts (tests); default is the repo
+# root, where bench.py looks for them
+_OUT = os.environ.get("TPU_PROBE_DIR", _ROOT)
+LOG_PATH = os.path.join(_OUT, "tpu_probe_log.jsonl")
+CACHE_PATH = os.path.join(_OUT, "tpu_cache.json")
+
+
+def _now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _log(entry: dict) -> None:
+    entry = {"ts": _now(), **entry}
+    with open(LOG_PATH, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    print(json.dumps(entry), flush=True)
+
+
+def _load_cache() -> dict:
+    try:
+        with open(CACHE_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return {}
+
+
+def _save_cache(cache: dict) -> None:
+    tmp = CACHE_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(cache, f, indent=1)
+    os.replace(tmp, CACHE_PATH)
+
+
+def _bench_mod():
+    """Import bench.py (repo root) lazily — its ``_tpu_probe`` and
+    ``_run_json_cmd`` are the single implementation of the probe /
+    JSON-subprocess handling shared with this daemon."""
+    if _ROOT not in sys.path:
+        sys.path.insert(0, _ROOT)
+    import bench
+    return bench
+
+
+def probe(timeout: int = 120) -> tuple:
+    """(status, detail): status is the backend name or "dead"."""
+    return _bench_mod()._tpu_probe(timeout)
+
+
+def _stage_selfcheck(env):
+    return _bench_mod()._run_json_cmd(
+        [sys.executable, os.path.join(_HERE, "tpu_selfcheck.py")], env,
+        timeout=int(os.environ.get("PROBE_SELFCHECK_TIMEOUT", "900")),
+        cwd=_ROOT)
+
+
+def _stage_flagship(env, small: bool):
+    env = dict(env)
+    if small:
+        env["BENCH_NBLOCK_PYLOPS_MPI_TPU"] = "1024"
+        env["BENCH_NITER_PYLOPS_MPI_TPU"] = "20"
+        env["BENCH_COMPONENTS_PYLOPS_MPI_TPU"] = "0"
+        env["BENCH_SELFCHECK_PYLOPS_MPI_TPU"] = "0"  # stage 1 covers it
+        timeout = int(os.environ.get("PROBE_SMALL_TIMEOUT", "900"))
+    else:
+        timeout = int(os.environ.get("PROBE_FULL_TIMEOUT", "2400"))
+    return _bench_mod()._run_json_cmd(
+        [sys.executable, os.path.join(_ROOT, "bench.py"), "--child"],
+        env, timeout=timeout, cwd=_ROOT)
+
+
+def harvest(cache: dict) -> dict:
+    """One live window: run whatever stages aren't cached yet; persist
+    after each. Returns the updated cache."""
+    env = dict(os.environ)
+    stages = [
+        ("selfcheck", lambda: _stage_selfcheck(env)),
+        ("flagship_small", lambda: _stage_flagship(env, small=True)),
+        ("flagship_full", lambda: _stage_flagship(env, small=False)),
+    ]
+    for name, runner in stages:
+        prev = cache.get(name)
+        if prev and prev.get("result") is not None and \
+                prev["result"].get("platform", "tpu") == "tpu" and \
+                not prev.get("error"):
+            continue  # already harvested on an earlier window
+        t0 = time.time()
+        result, err = runner()
+        entry = {"ts": _now(), "seconds": round(time.time() - t0, 1),
+                 "result": result}
+        if err:
+            entry["error"] = err
+        cache[name] = entry
+        _save_cache(cache)
+        _log({"status": "stage", "stage": name,
+              "ok": result is not None and not err,
+              "seconds": entry["seconds"],
+              **({"error": err} if err else {})})
+        if result is None:
+            break  # window probably died; re-probe before continuing
+    return cache
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=int, default=180)
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--once", action="store_true")
+    ap.add_argument("--probe-timeout", type=int, default=120)
+    args = ap.parse_args()
+
+    deadline = time.time() + args.max_hours * 3600
+    _log({"status": "daemon_start", "interval": args.interval,
+          "max_hours": args.max_hours})
+    while True:
+        status, detail = probe(args.probe_timeout)
+        _log({"status": status, **({"detail": detail} if detail else {})})
+        if status == "tpu":
+            cache = harvest(_load_cache())
+            full = cache.get("flagship_full", {})
+            res = full.get("result")
+            # platform must really be "tpu": a tunnel drop mid-stage
+            # makes the child silently fall back to cpu, and that cache
+            # entry will (rightly) not be promoted by bench.py — keep
+            # probing for a real window instead of declaring victory
+            if (res is not None and not full.get("error")
+                    and res.get("platform") == "tpu"):
+                _log({"status": "complete",
+                      "note": "full TPU flagship cached; daemon exiting"})
+                return
+        if args.once:
+            return
+        if time.time() + args.interval > deadline:
+            _log({"status": "daemon_deadline"})
+            return
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()

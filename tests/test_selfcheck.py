@@ -1,0 +1,84 @@
+"""The on-device selfcheck (benchmarks/tpu_selfcheck.py) must stay
+green on the CPU mesh: it is the gate that runs on every live TPU
+window before the headline bench, so a regression here would silently
+downgrade the TPU bench modes."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:  # each test must import repo modules alone
+    sys.path.insert(0, ROOT)
+
+
+@pytest.fixture(scope="module")
+def selfcheck_result():
+    from benchmarks.tpu_selfcheck import run_selfcheck
+    return run_selfcheck()
+
+
+def test_selfcheck_all_green(selfcheck_result):
+    bad = {k: v for k, v in selfcheck_result["checks"].items()
+           if not v.get("ok")}
+    assert selfcheck_result["ok"], f"selfcheck failures: {bad}"
+
+
+def test_selfcheck_covers_every_pallas_kernel(selfcheck_result):
+    # one check per public pallas entry point + the distributed hot paths
+    names = set(selfcheck_result["checks"])
+    assert {"pallas_first_derivative", "pallas_second_derivative",
+            "pallas_normal_matvec", "pallas_normal_matvec_bf16",
+            "summa_matmul", "pencil_fft2d", "ring_halo_stencil",
+            "fused_cgls"} <= names
+
+
+def test_probe_log_summary_and_cache_merge(tmp_path):
+    """bench.py must promote a cached TPU flagship over a degraded CPU
+    live run (full > small), attach the cached selfcheck, and summarize
+    the probe log."""
+    import bench
+    (tmp_path / "tpu_cache.json").write_text(json.dumps({
+        "selfcheck": {"ts": "T0", "result": {"ok": True,
+                                             "platform": "tpu"}},
+        "flagship_small": {"ts": "T1", "result": {
+            "platform": "tpu", "value": 500.0, "mfu": 0.02}},
+        "flagship_full": {"ts": "T2", "result": None, "error": "timeout"},
+    }))
+    (tmp_path / "tpu_probe_log.jsonl").write_text(
+        '{"ts": "A", "status": "dead"}\n'
+        '{"ts": "B", "status": "tpu"}\n'
+        '{"ts": "B2", "status": "stage", "stage": "selfcheck",'
+        ' "ok": true, "seconds": 30}\n')
+    merged = bench._merge_tpu_cache(
+        {"platform": "cpu", "value": 12.6, "degraded": True},
+        root=str(tmp_path))
+    assert merged["cached"] and merged["cache_stage"] == "flagship_small"
+    assert merged["value"] == 500.0 and merged["mfu"] == 0.02
+    assert merged["cpu_live"]["value"] == 12.6
+    assert merged["selfcheck"]["cached"] is True
+    assert merged["probe_log"]["attempts"] == 2
+    assert merged["probe_log"]["statuses"] == {"dead": 1, "tpu": 1}
+    assert merged["probe_log"]["stages"][0]["stage"] == "selfcheck"
+
+
+def test_probe_daemon_handles_dead_tunnel(tmp_path):
+    """`--once` with an unreachable backend must log one dead probe and
+    exit 0 without writing a cache."""
+    env = dict(os.environ)
+    env["PROBE_FORCE_PLATFORM"] = "cpu"  # deterministic, no tunnel hang
+    env["TPU_PROBE_DIR"] = str(tmp_path)  # keep the real log pristine
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "benchmarks",
+                                      "tpu_probe_loop.py"),
+         "--once", "--probe-timeout", "60"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=str(tmp_path))
+    assert p.returncode == 0
+    lines = [json.loads(l) for l in p.stdout.strip().splitlines()]
+    assert lines[0]["status"] == "daemon_start"
+    assert lines[1]["status"] == "cpu"  # live backend but not tpu: no
+    assert not (tmp_path / "tpu_cache.json").exists()  # harvest ran
