@@ -196,10 +196,10 @@ if __name__ == "__main__":
         # env-level JAX_PLATFORMS alone is insufficient: the TPU plugin
         # registered from sitecustomize can override it and hang at
         # backend init when the tunnel is down (see bench.py child_main)
-        os.environ.setdefault(
-            "XLA_FLAGS",
-            (os.environ.get("XLA_FLAGS", "")
-             + " --xla_force_host_platform_device_count=8").strip())
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = \
+                (flags + " --xla_force_host_platform_device_count=8").strip()
         import jax
         jax.config.update("jax_platforms", "cpu")
     print(json.dumps(run_selfcheck()))

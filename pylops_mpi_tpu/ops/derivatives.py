@@ -42,6 +42,78 @@ def _tuplize(dims) -> Tuple[int, ...]:
     return tuple(int(d) for d in np.atleast_1d(dims))
 
 
+def _stencil_spec(op) -> Optional[dict]:
+    """Uniform description of every supported axis-0 stencil as
+
+    ``y = Z · S x + E x``
+
+    where ``S`` is the pure interior stencil with zero boundary
+    condition (``taps``: input-offset → coefficient), ``Z`` zeroes the
+    first ``lo_z`` / last ``hi_z`` output rows, and ``E`` is the sparse
+    ``edge=True`` boundary matrix given as ``(out, in, coeff)`` triples
+    with rows addressed as ``("lo", i)`` = global row ``i`` or
+    ``("hi", i)`` = global row ``n-1-i``. The adjoint needs no separate
+    derivation: ``(Z·S)ᴴ = Sᵀ·Z`` (zero the masked *input* rows, run the
+    offset-reversed taps) and ``Eᴴ`` is the transposed triples. ``w`` is
+    the halo width = max |tap offset|.
+
+    Coefficient tables mirror the local scatter-free stencils in
+    ``ops/local.py`` (ref ``FirstDerivative.py:141-318``,
+    ``SecondDerivative.py:78-240``)."""
+    s = float(op.sampling)
+    if isinstance(op, _LocalFirst):
+        if op.kind == "forward":
+            return dict(w=1, taps={1: 1 / s, 0: -1 / s},
+                        lo_z=0, hi_z=1, edge=[])
+        if op.kind == "backward":
+            return dict(w=1, taps={0: 1 / s, -1: -1 / s},
+                        lo_z=1, hi_z=0, edge=[])
+        if op.order == 3:
+            spec = dict(w=1, taps={1: 1 / (2 * s), -1: -1 / (2 * s)},
+                        lo_z=1, hi_z=1, edge=[])
+            if op.edge:
+                spec["edge"] = [
+                    (("lo", 0), ("lo", 1), 1 / s),
+                    (("lo", 0), ("lo", 0), -1 / s),
+                    (("hi", 0), ("hi", 0), 1 / s),
+                    (("hi", 0), ("hi", 1), -1 / s)]
+            return spec
+        c = 1 / (12 * s)  # centered 5-point
+        spec = dict(w=2, taps={-2: c, -1: -8 * c, 1: 8 * c, 2: -c},
+                    lo_z=2, hi_z=2, edge=[])
+        if op.edge:
+            spec["edge"] = [
+                (("lo", 0), ("lo", 1), 1 / s),
+                (("lo", 0), ("lo", 0), -1 / s),
+                (("lo", 1), ("lo", 2), 1 / (2 * s)),
+                (("lo", 1), ("lo", 0), -1 / (2 * s)),
+                (("hi", 1), ("hi", 0), 1 / (2 * s)),
+                (("hi", 1), ("hi", 2), -1 / (2 * s)),
+                (("hi", 0), ("hi", 0), 1 / s),
+                (("hi", 0), ("hi", 1), -1 / s)]
+        return spec
+    if isinstance(op, _LocalSecond):
+        s2 = s * s
+        if op.kind == "forward":
+            return dict(w=2, taps={0: 1 / s2, 1: -2 / s2, 2: 1 / s2},
+                        lo_z=0, hi_z=2, edge=[])
+        if op.kind == "backward":
+            return dict(w=2, taps={0: 1 / s2, -1: -2 / s2, -2: 1 / s2},
+                        lo_z=2, hi_z=0, edge=[])
+        spec = dict(w=1, taps={-1: 1 / s2, 0: -2 / s2, 1: 1 / s2},
+                    lo_z=1, hi_z=1, edge=[])
+        if op.edge:
+            spec["edge"] = [
+                (("lo", 0), ("lo", 0), 1 / s2),
+                (("lo", 0), ("lo", 1), -2 / s2),
+                (("lo", 0), ("lo", 2), 1 / s2),
+                (("hi", 0), ("hi", 2), 1 / s2),
+                (("hi", 0), ("hi", 1), -2 / s2),
+                (("hi", 0), ("hi", 0), 1 / s2)]
+        return spec
+    return None
+
+
 class _StencilOperator(MPILinearOperator):
     """Common scaffolding: flat vector in → N-D stencil → flat vector out,
     with the reference's BROADCAST→SCATTER input conversion
@@ -81,80 +153,119 @@ class _StencilOperator(MPILinearOperator):
 
     def _apply_explicit(self, x: DistributedArray,
                         forward: bool) -> Optional[DistributedArray]:
-        """Hand-scheduled stencil path: one shard_map kernel with a
-        single ``ppermute`` pair exchanging only the boundary rows
-        (:func:`~pylops_mpi_tpu.parallel.collectives.ring_halo_extend`)
-        and one fused Pallas VMEM pass per shard
-        (:mod:`~pylops_mpi_tpu.ops.pallas_kernels`) — the explicit form
-        of the ghost-cell schedule the reference hand-codes with
-        Send/Recv (ref ``FirstDerivative.py:141-149``,
-        ``DistributedArray.py:877-954``). Applies to the centered-3,
-        ``edge=False``, axis-0, evenly-divisible case; returns ``None``
-        (generic implicit path) otherwise. Disable with
-        ``PYLOPS_MPI_TPU_EXPLICIT_STENCIL=0``."""
+        """Hand-scheduled stencil path: ONE shard_map kernel with a
+        single ``ppermute`` pair exchanging only the ``w`` boundary rows
+        (:func:`~pylops_mpi_tpu.parallel.collectives.cart_halo_extend`)
+        — the explicit form of the ghost-cell schedule the reference
+        hand-codes with Send/Recv (ref ``FirstDerivative.py:141-318``,
+        ``SecondDerivative.py:215-240``, ``DistributedArray.py:877-954``).
+
+        Covers every kind (forward/backward/centered), order (3/5),
+        ``edge`` flag, and ragged (pad-to-max) balanced splits, via the
+        ``y = Z·Sx + Ex`` decomposition of :func:`_stencil_spec`; the
+        adjoint is the same kernel with reversed taps, input-side zero
+        mask, and transposed edge triples. Centered-3 cores use the
+        fused Pallas VMEM pass on TPU. Returns ``None`` (generic
+        implicit GSPMD path) for non-axis-0 stencils, multi-dim meshes,
+        non-balanced layouts, or shards shorter than the halo/edge
+        span. Disable with ``PYLOPS_MPI_TPU_EXPLICIT_STENCIL=0``."""
         from ..utils import deps
         if not deps.explicit_stencil_enabled():
             return None
         op = self._local_op()
-        first = isinstance(op, _LocalFirst)
-        if first and not (op.axis == 0 and op.kind == "centered"
-                          and op.order == 3 and not op.edge):
+        if getattr(op, "axis", None) != 0:
             return None
-        if not first and not (isinstance(op, _LocalSecond) and op.axis == 0
-                              and op.kind == "centered" and not op.edge):
+        spec = _stencil_spec(op)
+        if spec is None:
             return None
         if len(self.mesh.axis_names) != 1:  # 1-D ring schedule only
             return None
         P_ = int(self.mesh.devices.size)
         dims = self.dims_nd
+        rows_tab = [int(s[0]) for s in
+                    local_split(dims, P_, Partition.SCATTER, 0)]
+        w = spec["w"]
+        # every shard must hold the halo slab (ghosts come from the
+        # immediate neighbour only); with edge corrections the boundary
+        # shards must additionally hold the 3-row span they read locally
+        min_rows = max(w, 3) if spec["edge"] else w
         if (x.partition != Partition.SCATTER or x.axis != 0 or x.ndim != 1
-                or dims[0] % P_ or dims[0] // P_ < 1 or not x._even
+                or min(rows_tab) < min_rows
                 or not jnp.issubdtype(x.dtype, jnp.floating)):
             return None
+        inner = int(np.prod(dims[1:])) if len(dims) > 1 else 1
+        if x._axis_sizes != tuple(r * inner for r in rows_tab):
+            return None  # bespoke layout: implicit path handles it
         from jax import shard_map
         from jax import lax
         from jax.sharding import PartitionSpec as PSpec
-        from ..parallel.collectives import ring_halo_extend
+        from ..parallel.collectives import halo_slab
         from .pallas_kernels import (first_derivative_centered,
                                      second_derivative)
 
-        rows = dims[0] // P_
+        rmax = max(rows_tab)
+        ragged = len(set(rows_tab)) > 1
         axis_name = self.mesh.axis_names[0]
-        s = op.sampling
+        n0 = dims[0]
+        lo_z, hi_z = spec["lo_z"], spec["hi_z"]
+        taps = (spec["taps"] if forward
+                else {-d: c for d, c in spec["taps"].items()})
+        triples = (spec["edge"] if forward
+                   else [(i, o, c) for (o, i, c) in spec["edge"]])
+        s = float(op.sampling)
         import jax as _jax
         on_tpu = _jax.default_backend() == "tpu"
-        if first:
-            def stencil(g):
-                # Pallas: one fused VMEM pass on TPU; the direct jnp form
-                # elsewhere (interpret-mode Pallas is test-only slow)
-                if on_tpu:
-                    return first_derivative_centered(g, axis=0,
-                                                     sampling=s)[1:-1]
-                return (g[2:] - g[:-2]) / (2.0 * s)
-        else:
-            def stencil(g):
-                if on_tpu:
-                    return second_derivative(g, axis=0, sampling=s)[1:-1]
-                return (g[2:] - 2.0 * g[1:-1] + g[:-2]) / s ** 2
-        # centered-3 first derivative is antisymmetric: the adjoint is
-        # the negated stencil applied to the edge-zeroed input; the
-        # second derivative's 3-point core is symmetric
-        sign = -1.0 if (first and not forward) else 1.0
+        # centered-3 taps as one fused Pallas VMEM pass (TPU): the
+        # first-derivative adjoint is the negated stencil, the second
+        # derivative core is symmetric — both are covered by a sign
+        pallas_core = None
+        if on_tpu and w == 1 and op.kind == "centered":
+            if isinstance(op, _LocalFirst):
+                sign = 1.0 if forward else -1.0
+                pallas_core = lambda g: sign * first_derivative_centered(
+                    g, axis=0, sampling=s)[1:-1]
+            else:
+                pallas_core = lambda g: second_derivative(
+                    g, axis=0, sampling=s)[1:-1]
+        valid_tab = jnp.asarray(rows_tab, dtype=jnp.int32)
+        base_tab = jnp.asarray(np.concatenate([[0], np.cumsum(rows_tab)[:-1]]),
+                               dtype=jnp.int32)
 
         def kernel(xb):
-            b = xb.reshape((rows,) + tuple(dims[1:]))
+            b = xb.reshape((rmax,) + tuple(dims[1:]))
             idx = lax.axis_index(axis_name)
+            valid = jnp.take(valid_tab, idx)
             row = lax.broadcasted_iota(jnp.int32, b.shape, 0)
-            gedge = (idx * rows + row == 0) | \
-                (idx * rows + row == dims[0] - 1)
-            if not forward:  # adjoint: zero rows the forward never wrote
-                b = jnp.where(gedge, jnp.zeros((), b.dtype), b)
-            g = ring_halo_extend(b, axis_name, P_, 1, 1)
-            y = stencil(g)
-            if sign != 1.0:
-                y = -y
-            if forward:  # edge=False: boundary rows are zero
-                y = jnp.where(gedge, jnp.zeros((), y.dtype), y)
+            G = jnp.take(base_tab, idx) + row  # global row index
+            zero = jnp.zeros((), b.dtype)
+            if ragged:  # scrub pad-tail garbage before it is exchanged
+                b = jnp.where(row < valid, b, zero)
+            b_orig = b  # edge corrections read the unmasked input
+            if not forward:  # (Z·S)ᴴ = Sᵀ·Z: zero the masked input rows
+                zin = (G < lo_z) | (G > n0 - 1 - hi_z)
+                b = jnp.where(zin, zero, b)
+            slab = halo_slab(b, axis_name, P_, 0, w, w, valid, rmax,
+                             ragged)
+            if pallas_core is not None:
+                y = pallas_core(slab)
+            else:
+                y = sum(c * lax.slice_in_dim(slab, w + d, w + d + rmax,
+                                             axis=0)
+                        for d, c in taps.items())
+            if forward and (lo_z or hi_z):
+                y = jnp.where((G < lo_z) | (G > n0 - 1 - hi_z), zero, y)
+            if triples:
+                first3 = b_orig[0:3]  # global rows 0..2 on shard 0
+                last3 = lax.dynamic_slice_in_dim(
+                    b_orig, jnp.maximum(valid - 3, 0), 3, axis=0)
+                for (oside, oi), (iside, ii), coef in triples:
+                    orow = oi if oside == "lo" else n0 - 1 - oi
+                    src = first3[ii] if iside == "lo" else last3[2 - ii]
+                    # masks select shard 0 / shard P-1 rows only, so the
+                    # other shards' (meaningless) src values are dropped
+                    y = y + jnp.where(G == orow, coef * src[None], zero)
+            if ragged:
+                y = jnp.where(row < valid, y, zero)
             return y.reshape(-1)
 
         out = shard_map(kernel, mesh=self.mesh, in_specs=PSpec(axis_name),

@@ -38,6 +38,7 @@ __all__ = [
     "all_to_all_resharding",
     "ring_halo_extend",
     "cart_halo_extend",
+    "halo_slab",
 ]
 
 
@@ -115,6 +116,32 @@ def cart_halo_extend(block: jax.Array, axis_name: str,
         perm = [(r, r - stride) for r in range(n) if coords[r] > 0]
         parts.append(lax.ppermute(slab, axis_name, perm))
     return jnp.concatenate(parts, axis=ax)
+
+
+def halo_slab(block, axis_name: str, n_shards: int, ax: int,
+              front: int, back: int, valid, s_phys: int,
+              ragged: bool):
+    """Ragged-aware ghosted slab for use *inside* a ``shard_map``
+    kernel: :func:`cart_halo_extend` along ``ax`` plus, for ragged
+    (pad-to-max) blocks, relocation of the received back ghost to sit
+    right after this shard's last VALID row (``front + valid``) instead
+    of after the padded tail. The relocation is a *local*
+    ``dynamic_update_slice`` inside the shard_map body — not the
+    GSPMD-partitioned scatter that miscompiles on sharded operands
+    (jax 0.9, see ``ops/local.py``'s scatter-free note). The caller
+    must scrub pad-tail garbage to zero BEFORE calling (the ghost sent
+    to the successor is this block's valid tail, but the pad rows
+    themselves travel nowhere — scrubbing keeps the slab's unused rows
+    zero). Shared by the explicit stencil kernels
+    (``ops/derivatives.py``) and ``DistributedArray.ghosted``."""
+    slab = cart_halo_extend(block, axis_name, (n_shards,), ax, front,
+                            back, valid)
+    if ragged and back:
+        bk = lax.slice_in_dim(slab, front + s_phys, front + s_phys + back,
+                              axis=ax)
+        slab = lax.dynamic_update_slice_in_dim(slab, bk, front + valid,
+                                               axis=ax)
+    return slab
 
 
 def ring_halo_extend(block, axis_name: str, n_shards: int,

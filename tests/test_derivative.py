@@ -265,6 +265,85 @@ def test_explicit_stencil_parity_and_hlo(rng, monkeypatch):
         monkeypatch.delenv("PYLOPS_MPI_TPU_EXPLICIT_STENCIL")
 
 
+_ALL_STENCILS = [
+    ("first", "forward", False, 3), ("first", "backward", False, 3),
+    ("first", "centered", False, 3), ("first", "centered", True, 3),
+    ("first", "centered", False, 5), ("first", "centered", True, 5),
+    ("second", "forward", False, None), ("second", "backward", False, None),
+    ("second", "centered", False, None), ("second", "centered", True, None),
+]
+
+
+def _make_pair(which, dims, kind, edge, order):
+    from pylops_mpi_tpu.ops.local import (FirstDerivative as _LF,
+                                          SecondDerivative as _LS)
+    if which == "first":
+        return (MPIFirstDerivative(dims, sampling=0.7, kind=kind, edge=edge,
+                                   order=order, dtype=np.float64),
+                _LF(dims, axis=0, sampling=0.7, kind=kind, edge=edge,
+                    order=order, dtype=np.float64))
+    return (MPISecondDerivative(dims, sampling=0.7, kind=kind, edge=edge,
+                                dtype=np.float64),
+            _LS(dims, axis=0, sampling=0.7, kind=kind, edge=edge,
+                dtype=np.float64))
+
+
+@pytest.mark.parametrize("which,kind,edge,order", _ALL_STENCILS)
+@pytest.mark.parametrize("dims", [(64,), (69,), (67, 5)])
+def test_explicit_stencil_full_sweep(rng, which, kind, edge, order, dims):
+    """Round-2 VERDICT #4: the explicit ring-halo schedule must cover
+    every kind x order x edge on even AND ragged splits, bit-equal to
+    the local stencil oracle for matvec and rmatvec. Ragged N-D inputs
+    must be row-aligned (``to_dist(local_shapes=...)``) to ride the
+    fast path; the plain flat split falls back to the implicit
+    formulation (checked separately below)."""
+    from pylops_mpi_tpu.distributedarray import local_split
+    Op, Loc = _make_pair(which, dims, kind, edge, order)
+    n = int(np.prod(dims))
+    x = rng.standard_normal(n)
+    P = Op.mesh.devices.size
+    if len(dims) > 1 and dims[0] % P:
+        shapes = local_split(dims, P, Partition.SCATTER, 0)
+        locals_ = [(int(np.prod(s)),) for s in shapes]
+        dx = DistributedArray.to_dist(x, local_shapes=locals_)
+    else:
+        dx = DistributedArray.to_dist(x)
+    exp = Op._apply_explicit(dx, True)
+    assert exp is not None, "expected the explicit path to engage"
+    np.testing.assert_allclose(exp.asarray(), np.asarray(Loc._matvec(x)),
+                               rtol=1e-12, atol=1e-12)
+    adj = Op._apply_explicit(dx, False)
+    np.testing.assert_allclose(adj.asarray(), np.asarray(Loc._rmatvec(x)),
+                               rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize("which,kind,edge,order", _ALL_STENCILS)
+def test_stencil_hlo_schedule(rng, which, kind, edge, order, monkeypatch):
+    """Round-2 VERDICT #4: the lowered schedule must stay boundary-slab
+    collective-permutes with NO all-gather for every variant — on the
+    explicit path AND on the implicit GSPMD path (round 1 showed the
+    partitioner can silently lower stencils to full gathers; this pins
+    the good schedule for both)."""
+    import jax
+    dims = (64, 4)
+    Op, _ = _make_pair(which, dims, kind, edge, order)
+    dx = DistributedArray.to_dist(rng.standard_normal(int(np.prod(dims))))
+    monkeypatch.setenv("PYLOPS_MPI_TPU_EXPLICIT_STENCIL", "1")
+    assert Op._apply_explicit(dx, True) is not None
+    for forward in (True, False):
+        hlo = jax.jit(
+            lambda v, f=forward: Op._apply(v, f)._arr
+        ).lower(dx).compile().as_text()
+        assert "collective-permute" in hlo
+        assert "all-gather" not in hlo
+    monkeypatch.setenv("PYLOPS_MPI_TPU_EXPLICIT_STENCIL", "0")
+    for forward in (True, False):
+        hlo = jax.jit(
+            lambda v, f=forward: Op._apply(v, f)._arr
+        ).lower(dx).compile().as_text()
+        assert "all-gather" not in hlo, "implicit path regressed to gather"
+
+
 def test_explicit_stencil_nd_and_fallbacks(rng):
     """N-D layouts ride the fast path; ragged or non-centered configs
     fall back to the implicit path with identical results."""

@@ -69,7 +69,7 @@ def test_probe_daemon_handles_dead_tunnel(tmp_path):
     """`--once` with an unreachable backend must log one dead probe and
     exit 0 without writing a cache."""
     env = dict(os.environ)
-    env["PROBE_FORCE_PLATFORM"] = "cpu"  # deterministic, no tunnel hang
+    env["PYLOPS_MPI_TPU_TEST_FORCE_PROBE"] = "cpu"  # no tunnel hang
     env["TPU_PROBE_DIR"] = str(tmp_path)  # keep the real log pristine
     p = subprocess.run(
         [sys.executable, os.path.join(ROOT, "benchmarks",
