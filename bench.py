@@ -450,24 +450,6 @@ def _probe_log_summary(root=None):
         return None
 
 
-def _has_cached_tpu_flagship(root=None):
-    """True when the probe daemon has already harvested a promotable
-    TPU flagship — the degraded-CPU extras (single-device rerun) can
-    then be skipped, since the cached TPU number supersedes them."""
-    root = root or os.path.dirname(os.path.abspath(__file__))
-    try:
-        with open(os.path.join(root, "tpu_cache.json")) as f:
-            cache = json.load(f)
-    except Exception:
-        return False
-    for key in ("flagship_full", "flagship_small"):
-        ent = cache.get(key) or {}
-        r = ent.get("result")
-        if r and r.get("platform") == "tpu" and not ent.get("error"):
-            return True
-    return False
-
-
 def _merge_tpu_cache(result, root=None):
     """If the live run degraded to CPU but the probe daemon harvested a
     TPU window earlier in the round, promote the cached TPU flagship to
@@ -545,10 +527,12 @@ def main():
             # where the 8-virtual-device mesh (above) loses by carving
             # one socket's threads/bandwidth into 8 sync'd slices.
             # Skipped when the probe daemon already harvested a TPU
-            # flagship that will supersede this CPU artifact anyway.
-            if _has_cached_tpu_flagship():
-                result = _merge_tpu_cache(result)
-                print(json.dumps(result))
+            # flagship that supersedes this CPU artifact — detected by
+            # the SAME promotion logic that will build the final
+            # artifact, so the two can never disagree.
+            merged = _merge_tpu_cache(dict(result))
+            if merged.get("cached"):
+                print(json.dumps(merged))
                 return
             env1 = dict(os.environ)
             env1["JAX_PLATFORMS"] = "cpu"

@@ -722,13 +722,36 @@ class DistributedArray:
                                      local_shapes=tuple(out_locals))
         return out
 
+    def _ghost_cells_gather(self, cells_front, cells_back) -> List[jax.Array]:
+        """Slice-from-global form: the mesh-shape-independent (and
+        gather-scaling) fallback, kept for multi-axis meshes and as the
+        oracle the ring-exchange kernel is tested against."""
+        front, back = self._ghost_widths(cells_front, cells_back)
+        sizes = self._axis_sizes
+        offs = np.concatenate([[0], np.cumsum(sizes)])
+        g = self._global()
+        out = []
+        for i in range(self._n_shards):
+            lo = max(0, int(offs[i]) - (front if i > 0 else 0))
+            hi = min(self._global_shape[self._axis],
+                     int(offs[i + 1]) + (back if i < self._n_shards - 1 else 0))
+            idx = [slice(None)] * self.ndim
+            idx[self._axis] = slice(lo, hi)
+            out.append(g[tuple(idx)])
+        return out
+
     def add_ghost_cells(self, cells_front: Optional[int] = None,
                         cells_back: Optional[int] = None) -> List[jax.Array]:
         """Per-shard ghosted arrays as a host-side list
         (ref ``DistributedArray.py:877-954`` returns the per-rank
         ``local_array``). The device computation is the single
-        ppermute-pair kernel of :meth:`ghosted`; the list is one
-        device_get plus host slicing."""
+        ppermute-pair kernel of :meth:`ghosted` (one device_get plus
+        host slicing); multi-axis (hybrid dcn×ici) meshes take the
+        slice-from-global fallback, which has no mesh-shape
+        dependence."""
+        if (self._partition == Partition.SCATTER
+                and len(self._mesh.axis_names) != 1):
+            return self._ghost_cells_gather(cells_front, cells_back)
         return [jnp.asarray(a) for a in
                 self.ghosted(cells_front, cells_back).local_arrays()]
 

@@ -393,3 +393,40 @@ def aslinearoperator(Op) -> MPILinearOperator:
 
 
 asmpilinearoperator = aslinearoperator
+
+
+# --------------------------------------------------- operators as pytrees
+# Multi-process JAX forbids closing over arrays that span non-addressable
+# devices: "Please pass such arrays as arguments to the function". The
+# fused solvers therefore pass the OPERATOR itself as a jit argument
+# whenever its class is registered here — its device buffers flatten to
+# pytree children while everything else (shapes, meshes, sub-operator
+# lists) rides along as aux, compared by object identity for the
+# compilation cache. This is what makes ``cgls(...)`` work unchanged on
+# a 2-process ``jax.distributed`` CPU job (tests/multihost_worker.py)
+# and on multi-host pods, replacing the reference's per-rank operator
+# state (each rank owning only its local block).
+
+OP_ARRAY_PYTREES = set()
+
+
+def register_operator_arrays(cls, *attrs: str) -> None:
+    """Register ``cls`` as a jax pytree whose children are the device
+    buffers (or registered sub-operators) stored in ``attrs``; the
+    instance itself is the aux. Unflatten shallow-copies the instance
+    and swaps in the (possibly traced) children, so operator methods
+    run unmodified under trace."""
+    import copy
+    import jax
+
+    def _flatten(op):
+        return tuple(getattr(op, a) for a in attrs), op
+
+    def _unflatten(aux, children):
+        new = copy.copy(aux)
+        for a, c in zip(attrs, children):
+            setattr(new, a, c)
+        return new
+
+    jax.tree_util.register_pytree_node(cls, _flatten, _unflatten)
+    OP_ARRAY_PYTREES.add(cls)

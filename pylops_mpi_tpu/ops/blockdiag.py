@@ -194,3 +194,9 @@ class MPIStackedBlockDiag(MPIStackedLinearOperator):
     def _rmatvec(self, x: StackedDistributedArray) -> StackedDistributedArray:
         return StackedDistributedArray(
             [op.rmatvec(d) for op, d in zip(self.ops, x.distarrays)])
+
+
+# the batched block stack travels into jit as a pytree argument
+# (multi-process arrays must not be closed over — linearoperator.py)
+from ..linearoperator import register_operator_arrays  # noqa: E402
+register_operator_arrays(MPIBlockDiag, "_batched")

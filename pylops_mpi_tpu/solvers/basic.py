@@ -357,10 +357,26 @@ def clear_fused_cache() -> None:
     _FUSED_CACHE.clear()
 
 
-def _get_fused(Op, key, builder):
+def _get_fused(Op, key, make_builder):
+    """Compile (and cache) the fused loop for ``Op``.
+    ``make_builder(op)`` must return the loop with that operator bound.
+    Registered operator classes (``linearoperator.OP_ARRAY_PYTREES``)
+    enter the jitted program as a pytree ARGUMENT — their device
+    buffers are traced, not closed over, which multi-process JAX
+    requires for arrays spanning non-addressable devices (exercised by
+    tests/multihost_worker.py). Unregistered operators keep the
+    closure form."""
+    from ..linearoperator import OP_ARRAY_PYTREES
     entry = _FUSED_CACHE.get(key)
     if entry is None:
-        entry = (jax.jit(builder), Op)
+        if type(Op) in OP_ARRAY_PYTREES:
+            jfn = jax.jit(lambda op, *a, **k: make_builder(op)(*a, **k))
+
+            def fn(*a, _jfn=jfn, _op=Op, **k):
+                return _jfn(_op, *a, **k)
+        else:
+            fn = jax.jit(make_builder(Op))
+        entry = (fn, Op)
         _FUSED_CACHE[key] = entry
         if len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
             _FUSED_CACHE.popitem(last=False)
@@ -383,7 +399,7 @@ def cg(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
                          "fused=False for per-iteration hooks")
     if use_fused:
         fn = _get_fused(Op, (id(Op), "cg", niter, _vkey(y), _vkey(x0)),
-                        partial(_cg_fused, Op, niter=niter))
+                        lambda op: partial(_cg_fused, op, niter=niter))
         x, iiter, cost = fn(y=y, x0=x0, tol=tol)
         iiter = int(iiter)
         return x, iiter, np.asarray(cost)[:iiter + 1]
@@ -418,7 +434,7 @@ def cgls(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
         builder = _cgls_fused_normal if use_normal else _cgls_fused
         fn = _get_fused(Op, (id(Op), "cgls", use_normal, niter, _vkey(y),
                              _vkey(x0)),
-                        partial(builder, Op, niter=niter))
+                        lambda op: partial(builder, op, niter=niter))
         x, iiter, cost, cost1, kold = fn(y=y, x0=x0, damp=damp, tol=tol)
         iiter = int(iiter)
         istop = 1 if float(jnp.max(kold)) < tol else 2

@@ -319,9 +319,9 @@ def _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha, eigsdict, tol,
            id(SOp) if SOp is not None else None, len(decay),
            _vkey(y), _vkey(x0))
     fn = _get_fused(Op, key,
-                    partial(_ista_fused, Op, niter=niter,
-                            threshf=_THRESHF[threshkind], SOp=SOp,
-                            momentum=momentum))
+                    lambda op: partial(_ista_fused, op, niter=niter,
+                                       threshf=_THRESHF[threshkind],
+                                       SOp=SOp, momentum=momentum))
     x, iiter, cost = fn(y=y, x0=x0, alpha=alpha, eps=eps, tol=tol,
                         decay=jnp.asarray(decay))
     iiter = int(iiter)

@@ -23,12 +23,21 @@ class MPIStackedLinearOperator(MPILinearOperator):
 
     def dot(self, x):
         from .ops.stack import MPIStackedVStack
+        from .ops.blockdiag import MPIStackedBlockDiag
         if isinstance(x, MPIStackedLinearOperator) or \
                 isinstance(x, MPILinearOperator):
-            # the reference forbids VStack @ VStack and mismatched
-            # BlockDiag products (StackedLinearOperator.py:430-443)
+            # the reference forbids VStack @ VStack and length-mismatched
+            # BlockDiag products (StackedLinearOperator.py:430-443) —
+            # without the guard the zip over components would silently
+            # truncate and return a wrong-shaped answer much later
             if isinstance(self, MPIStackedVStack) and \
                     isinstance(x, MPIStackedVStack):
                 raise ValueError(
-                    "cannot multiply two MPIStackedVStack operators")
+                    "both operands cannot be MPIStackedVStack")
+            if (isinstance(self, MPIStackedBlockDiag)
+                    and isinstance(x, MPIStackedBlockDiag)
+                    and len(self.ops) != len(x.ops)):
+                raise ValueError(
+                    "both MPIStackedBlockDiag cannot have different "
+                    f"number of ops, {len(self.ops)} != {len(x.ops)}")
         return super().dot(x)

@@ -1,0 +1,92 @@
+"""Worker for the 2-process ``jax.distributed`` smoke test.
+
+Each of the two processes runs this script with 4 virtual CPU devices;
+after ``initialize_multihost`` the global device count is 8 and the
+dcn(2) x ici(4) hybrid mesh spans both processes — the pod-scale
+bootstrap of ``parallel/mesh.py:98-137`` exercised for real (the
+analog of the reference's mpiexec + NCCL-id handshake CI runs,
+ref ``.github/workflows/build.yml``). Runs one fused CGLS solve on an
+MPIBlockDiag and one SUMMA apply, checks both against NumPy, prints
+``MULTIHOST OK`` on success.
+
+Usage: python multihost_worker.py <coordinator_port> <process_id>
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4"
+                           ).strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:  # cross-process CPU collectives (name varies across jax versions)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def main() -> None:
+    port, pid = int(sys.argv[1]), int(sys.argv[2])
+    import pylops_mpi_tpu as pmt
+    pmt.initialize_multihost(coordinator_address=f"localhost:{port}",
+                             num_processes=2, process_id=pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, len(jax.devices())
+
+    import numpy as np
+    import jax.numpy as jnp
+    from pylops_mpi_tpu.ops.local import MatrixMult
+
+    mesh = pmt.make_mesh_hybrid(dcn_size=2)
+    assert mesh.devices.shape == (2, 4), mesh.devices.shape
+    pmt.set_default_mesh(mesh)
+
+    rng = np.random.default_rng(0)  # identical data on both processes
+    n = 64
+    blocks = []
+    for _ in range(8):
+        b = (rng.standard_normal((n, n)) / np.sqrt(n)).astype(np.float32)
+        np.fill_diagonal(b, b.diagonal() + 4.0)
+        blocks.append(b)
+    xt = rng.standard_normal(8 * n).astype(np.float32)
+    y = np.concatenate([b @ xt[i * n:(i + 1) * n]
+                        for i, b in enumerate(blocks)])
+
+    Op = pmt.MPIBlockDiag([MatrixMult(b, dtype=np.float32) for b in blocks])
+    dy = pmt.DistributedArray.to_dist(y, mesh=mesh)
+    x0 = pmt.DistributedArray.to_dist(np.zeros_like(xt), mesh=mesh)
+    # the PUBLIC solver: the fused loop receives the operator as a
+    # pytree jit argument (linearoperator.py registry) — multi-process
+    # JAX forbids closing over arrays spanning non-addressable devices
+    xs, istop, iiter, *_ = pmt.cgls(Op, dy, x0=x0, niter=40, tol=0.0)
+    # errors are computed ON device (psum-reduced to a replicated
+    # scalar): host-gathering a multi-process array's non-addressable
+    # shards is exactly what a real pod job must avoid
+    err = float(jax.jit(
+        lambda a: jnp.linalg.norm(a - jnp.asarray(xt))
+        / np.linalg.norm(xt))(xs._arr))
+    assert err < 1e-3, f"CGLS rel err {err}"
+
+    # SUMMA apply across the hybrid mesh's flattened device order
+    A = rng.standard_normal((48, 40)).astype(np.float32)
+    M = 8
+    S = pmt.MPIMatrixMult(A, M=M, kind="summa", dtype=np.float32)
+    xs = rng.standard_normal(S.shape[1]).astype(np.float32)
+    ys = S @ pmt.DistributedArray.to_dist(xs, mesh=S.mesh)
+    want = (A @ xs.reshape(40, M)).ravel()
+    serr = float(jax.jit(
+        lambda a: jnp.linalg.norm(a - jnp.asarray(want))
+        / np.linalg.norm(want))(ys._arr))
+    assert serr < 1e-4, f"SUMMA rel err {serr}"
+
+    print(f"MULTIHOST OK p{pid} cgls_err={err:.2e} summa_err={serr:.2e}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
