@@ -135,7 +135,8 @@ def child_main():
             if not ck.get("pallas_normal_matvec_bf16", {}).get("ok"):
                 allow_bf16_storage = False
             if not (ck.get("pallas_first_derivative", {}).get("ok")
-                    and ck.get("pallas_second_derivative", {}).get("ok")):
+                    and ck.get("pallas_second_derivative", {}).get("ok")
+                    and ck.get("pallas_stencil_taps", {}).get("ok")):
                 os.environ["PYLOPS_MPI_TPU_EXPLICIT_STENCIL"] = "0"
                 os.environ["BENCH_STENCIL_SELFCHECK_DEAD"] = "1"
         except Exception as e:

@@ -194,9 +194,16 @@ def run_components(quick: bool = False, only=None):
             continue
         _progress(name)
         try:
-            results.append(fn(pmt, rng, n_dev, scale))
+            r = fn(pmt, rng, n_dev, scale)
         except Exception as e:
-            results.append({"bench": name, "error": repr(e)[:300]})
+            r = {"bench": name, "error": repr(e)[:300]}
+        # record the size regime so quick-mode (scale=1) GB/s / GFLOP/s
+        # numbers cannot be misread as full-size results (round-2
+        # VERDICT weak #8)
+        r.setdefault("scale", scale)
+        if quick:
+            r.setdefault("quick_mode", True)
+        results.append(r)
     return results
 
 

@@ -125,6 +125,18 @@ def run_selfcheck() -> dict:
         return max(_rel_err(q, qw), _rel_err(u, uw))
     checks["pallas_normal_matvec_bf16"] = _check(nmb, tol=3e-3)
 
+    # --- generic tap-stencil kernel (order-5 taps, the widest case)
+    def taps():
+        w = 2
+        taps5 = ((-2, 1 / 12), (-1, -8 / 12), (1, 8 / 12), (2, -1 / 12))
+        slab = rng.standard_normal((132, 256)).astype(np.float32)
+        got = jax.jit(lambda v: pk.stencil_taps(v, taps5, w))(
+            jnp.asarray(slab))
+        want = (slab[:-4] - 8 * slab[1:-3] + 8 * slab[3:-1]
+                - slab[4:]) / 12.0
+        return _rel_err(got, want)
+    checks["pallas_stencil_taps"] = _check(taps)
+
     # --- SUMMA shard_map GEMM (forward + adjoint) vs dense NumPy
     def summa():
         A = rng.standard_normal((192, 160)).astype(np.float32)

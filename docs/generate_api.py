@@ -71,7 +71,7 @@ PAGES = {
           "HStack", "BlockDiag", "FFT", "Conv1D",
           "NonStationaryConvolve1D"]),
         ("Pallas TPU kernels", "pylops_mpi_tpu.ops.pallas_kernels",
-         ["first_derivative_centered", "second_derivative",
+         ["first_derivative_centered", "second_derivative", "stencil_taps",
           "batched_normal_matvec", "normal_matvec_supported",
           "pallas_available"]),
     ],

@@ -327,9 +327,19 @@ class DistributedArray:
 
     def local_arrays(self) -> List[np.ndarray]:
         """Per-shard views under the logical split — debug/parity helper
-        standing in for the reference's per-rank ``local_array``."""
+        standing in for the reference's per-rank ``local_array``. For
+        non-SCATTER partitions this materializes P host copies of the
+        full array (warned above 256 MB total) — prefer ``asarray()``
+        when one copy is enough."""
         if self._partition != Partition.SCATTER:
             g = self.asarray()
+            if g.nbytes * self._n_shards > 256 * 1024 ** 2:
+                import warnings
+                warnings.warn(
+                    f"local_arrays on a {self._partition.name} array "
+                    f"copies all {g.nbytes >> 20} MB x {self._n_shards} "
+                    "shards to host; use asarray() for one copy",
+                    stacklevel=2)
             return [g.copy() for _ in range(self._n_shards)]
         phys = np.asarray(jax.device_get(self._arr))
         sp = self._s_phys

@@ -176,9 +176,16 @@ class MPILinearOperator:
         """Dense matrix of the operator, by applying it to each identity
         column and gathering (serial-pylops convenience; the MPI
         reference has no equivalent because no rank holds the global
-        matrix). O(n) matvecs — intended for tests and small operators."""
+        matrix). O(n) matvecs — intended for tests and small operators
+        (warned above n=8192)."""
         from .distributedarray import DistributedArray
         m, n = self.shape
+        if n > 8192:
+            import warnings
+            warnings.warn(
+                f"todense() runs {n} distributed matvecs and builds an "
+                f"{m}x{n} dense matrix on host — tests/small operators "
+                "only", stacklevel=2)
         dt = np.dtype(self.dtype)
         mesh = getattr(self, "mesh", None)
         shapes = getattr(self, "local_shapes_m",
@@ -207,10 +214,15 @@ class _AdjointLinearOperator(MPILinearOperator):
     """ref ``LinearOperator.py:408-421``"""
 
     def __init__(self, A: MPILinearOperator):
-        self.A = A
         self.dims, self.dimsd = A.dimsd, A.dims
         super().__init__(shape=(A.shape[1], A.shape[0]), dtype=A.dtype)
         self.args = (A,)
+
+    @property
+    def A(self):
+        # via args so pytree unflattening (which swaps args) keeps the
+        # methods reading the traced sub-operator, not a stale copy
+        return self.args[0]
 
     def _matvec(self, x):
         return self.A._rmatvec(x)
@@ -223,10 +235,13 @@ class _TransposedLinearOperator(MPILinearOperator):
     """transpose = conj ∘ rmatvec ∘ conj (ref ``LinearOperator.py:424-443``)"""
 
     def __init__(self, A: MPILinearOperator):
-        self.A = A
         self.dims, self.dimsd = A.dimsd, A.dims
         super().__init__(shape=(A.shape[1], A.shape[0]), dtype=A.dtype)
         self.args = (A,)
+
+    @property
+    def A(self):
+        return self.args[0]  # see _AdjointLinearOperator.A
 
     def _matvec(self, x):
         return self.A._rmatvec(x.conj()).conj()
@@ -267,15 +282,22 @@ class _ScaledLinearOperator(MPILinearOperator):
         self.dims, self.dimsd = A.dims, A.dimsd
         super().__init__(shape=A.shape, dtype=_get_dtype([A], [type(alpha)]))
 
+    @staticmethod
+    def _conj(alpha):
+        # host conj for concrete scalars (keeps scalar dispatch in
+        # ``dot`` working); jnp.conj for the traced leaf the pytree
+        # registration turns alpha into under jit
+        return np.conj(alpha) if np.isscalar(alpha) else jnp.conj(alpha)
+
     def _matvec(self, x):
         return self.args[0].matvec(x) * self.args[1]
 
     def _rmatvec(self, x):
-        return self.args[0].rmatvec(x) * np.conj(self.args[1])
+        return self.args[0].rmatvec(x) * self._conj(self.args[1])
 
     def _adjoint(self):
         A, alpha = self.args
-        return A.H * np.conj(alpha)
+        return A.H * self._conj(alpha)
 
 
 class _SumLinearOperator(MPILinearOperator):
@@ -308,12 +330,16 @@ class _PowerLinearOperator(MPILinearOperator):
         if not isinstance(p, (int, np.integer)) or p < 0:
             raise ValueError("non-negative integer expected as p")
         self.args = (A, p)
+        # p also kept OUTSIDE args: when the operator travels into jit
+        # as a pytree argument, args' leaves are traced — the loop
+        # bound must stay a static python int
+        self._p = int(p)
         self.dims, self.dimsd = A.dims, A.dimsd
         super().__init__(shape=A.shape, dtype=A.dtype)
 
     def _power(self, fun, x):
         res = x.copy()
-        for _ in range(self.args[1]):
+        for _ in range(self._p):
             res = fun(res)
         return res
 
@@ -328,10 +354,13 @@ class _ConjLinearOperator(MPILinearOperator):
     """ref ``LinearOperator.py:555-580``"""
 
     def __init__(self, A: MPILinearOperator):
-        self.A = A
         self.dims, self.dimsd = A.dims, A.dimsd
         super().__init__(shape=A.shape, dtype=A.dtype)
         self.args = (A,)
+
+    @property
+    def A(self):
+        return self.args[0]  # see _AdjointLinearOperator.A
 
     def _matvec(self, x):
         return self.A._matvec(x.conj()).conj()
@@ -355,21 +384,26 @@ class _CheckpointedLinearOperator(MPILinearOperator):
                   "local_extent_sizes")
 
     def __init__(self, A: MPILinearOperator):
-        import jax
-        self.A = A
         for attr in self._FORWARDED:
             if hasattr(A, attr):
                 setattr(self, attr, getattr(A, attr))
         super().__init__(shape=A.shape, dtype=A.dtype)
         self.args = (A,)
-        self._mv = jax.checkpoint(A.matvec)
-        self._rmv = jax.checkpoint(A.rmatvec)
 
+    @property
+    def A(self):
+        return self.args[0]  # see _AdjointLinearOperator.A
+
+    # checkpoint wrapping happens per call (cheap at trace time): a
+    # bound-at-init closure would pin the ORIGINAL operator's buffers
+    # even after pytree unflattening swapped in traced ones
     def _matvec(self, x):
-        return self._mv(x)
+        import jax
+        return jax.checkpoint(self.args[0].matvec)(x)
 
     def _rmatvec(self, x):
-        return self._rmv(x)
+        import jax
+        return jax.checkpoint(self.args[0].rmatvec)(x)
 
     def _adjoint(self):
         return _CheckpointedLinearOperator(self.A.H)
@@ -430,3 +464,36 @@ def register_operator_arrays(cls, *attrs: str) -> None:
 
     jax.tree_util.register_pytree_node(cls, _flatten, _unflatten)
     OP_ARRAY_PYTREES.add(cls)
+
+
+def operator_is_jit_arg(Op) -> bool:
+    """True when ``Op`` can safely travel into ``jax.jit`` as a pytree
+    argument: its class is registered AND every flattened leaf is an
+    array/scalar. A registered wrapper composed over an UNREGISTERED
+    user operator flattens that child to an opaque leaf, which jit
+    would reject — such compositions fall back to closure capture
+    (works single-process; multi-process users must register their
+    classes, see docs/multihost.md)."""
+    if type(Op) not in OP_ARRAY_PYTREES:
+        return False
+    import jax
+    import numpy as _np
+    return all(
+        l is None or isinstance(l, (jax.Array, _np.ndarray, _np.number,
+                                    int, float, complex, bool))
+        for l in jax.tree_util.tree_leaves(Op))
+
+
+# The base class (aslinearoperator instances) and every lazy wrapper:
+# wrappers expose their sub-operators through ``args`` so compositions
+# like (Op.H @ Op) or eps*Reg recurse into the registered leaves.
+# Array-less classes register with NO attrs — they still need to be
+# pytree nodes to be valid CHILDREN of a registered wrapper. The
+# _Power wrapper's exponent and _Scaled's alpha ride in args as traced
+# leaves; the static copies (_p / dtype math) stay in aux.
+register_operator_arrays(MPILinearOperator)
+for _w in (_AdjointLinearOperator, _TransposedLinearOperator,
+           _ProductLinearOperator, _ScaledLinearOperator,
+           _SumLinearOperator, _PowerLinearOperator,
+           _ConjLinearOperator, _CheckpointedLinearOperator):
+    register_operator_arrays(_w, "args")

@@ -200,3 +200,4 @@ class MPIStackedBlockDiag(MPIStackedLinearOperator):
 # (multi-process arrays must not be closed over — linearoperator.py)
 from ..linearoperator import register_operator_arrays  # noqa: E402
 register_operator_arrays(MPIBlockDiag, "_batched")
+register_operator_arrays(MPIStackedBlockDiag, "ops")

@@ -200,8 +200,7 @@ class _StencilOperator(MPILinearOperator):
         from jax import lax
         from jax.sharding import PartitionSpec as PSpec
         from ..parallel.collectives import halo_slab
-        from .pallas_kernels import (first_derivative_centered,
-                                     second_derivative)
+        from .pallas_kernels import stencil_taps
 
         rmax = max(rows_tab)
         ragged = len(set(rows_tab)) > 1
@@ -212,21 +211,24 @@ class _StencilOperator(MPILinearOperator):
                 else {-d: c for d, c in spec["taps"].items()})
         triples = (spec["edge"] if forward
                    else [(i, o, c) for (o, i, c) in spec["edge"]])
-        s = float(op.sampling)
         import jax as _jax
         on_tpu = _jax.default_backend() == "tpu"
-        # centered-3 taps as one fused Pallas VMEM pass (TPU): the
-        # first-derivative adjoint is the negated stencil, the second
-        # derivative core is symmetric — both are covered by a sign
+        # any tap set runs as ONE fused Pallas VMEM pass on TPU (the
+        # slab is loaded once; every tap is a shifted slice of the
+        # loaded block) — but ONLY when the whole slab fits the VMEM
+        # budget: the unblocked pallas_call would fail Mosaic
+        # compilation on bigger shards, where the jnp slice form (XLA
+        # fuses the shifts) handles any size
         pallas_core = None
-        if on_tpu and w == 1 and op.kind == "centered":
-            if isinstance(op, _LocalFirst):
-                sign = 1.0 if forward else -1.0
-                pallas_core = lambda g: sign * first_derivative_centered(
-                    g, axis=0, sampling=s)[1:-1]
-            else:
-                pallas_core = lambda g: second_derivative(
-                    g, axis=0, sampling=s)[1:-1]
+        inner_bytes = inner * np.dtype(x.dtype).itemsize
+        slab_bytes = (rmax + 2 * w) * inner_bytes
+        if on_tpu and slab_bytes <= 8 << 20:  # half of ~16 MB VMEM
+            taps_t = tuple(sorted(taps.items()))
+
+            def pallas_core(slab, _t=taps_t):
+                flat = slab.reshape(slab.shape[0], -1)
+                out = stencil_taps(flat, _t, w)
+                return out.reshape((rmax,) + slab.shape[1:])
         valid_tab = jnp.asarray(rows_tab, dtype=jnp.int32)
         base_tab = jnp.asarray(np.concatenate([[0], np.cumsum(rows_tab)[:-1]]),
                                dtype=jnp.int32)
@@ -420,3 +422,12 @@ class _AxisFirstDerivative(_StencilOperator):
 
     def _local_op(self):
         return self._op
+
+
+# array-less pytree registration: lets stencil operators ride inside
+# registered wrapper compositions passed into jit (linearoperator.py)
+from ..linearoperator import register_operator_arrays  # noqa: E402
+for _c in (MPIFirstDerivative, MPISecondDerivative, MPILaplacian,
+           _AxisFirstDerivative):
+    register_operator_arrays(_c)
+register_operator_arrays(MPIGradient, "Op")

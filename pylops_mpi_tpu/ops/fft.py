@@ -581,3 +581,10 @@ class MPIFFT2D(_MPIBaseFFTND):
                          ifftshift_before=ifftshift_before,
                          fftshift_after=fftshift_after, mesh=mesh,
                          dtype=dtype)
+
+
+# array-less pytree registration (shift/scale factors are rebuilt from
+# static shape metadata at trace time)
+from ..linearoperator import register_operator_arrays  # noqa: E402
+register_operator_arrays(MPIFFTND)
+register_operator_arrays(MPIFFT2D)

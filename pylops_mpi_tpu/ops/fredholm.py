@@ -127,3 +127,9 @@ class MPIFredholm1(MPILinearOperator):
         GT = self.GT if self.GT is not None else jnp.conj(self.G).transpose(0, 2, 1)
         m = jnp.einsum("kyx,kxz->kyz", GT, d)
         return self._wrap(m, x, self.shape[1], self.ny)
+
+
+# the frequency-sharded kernel travels into jit as a pytree child
+# (multi-process arrays must not be closed over — linearoperator.py)
+from ..linearoperator import register_operator_arrays  # noqa: E402
+register_operator_arrays(MPIFredholm1, "G", "GT")

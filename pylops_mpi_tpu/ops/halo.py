@@ -318,3 +318,8 @@ class MPIHalo(MPILinearOperator):
             arr, x, global_shape=(self.shape[1],),
             local_shapes=self.local_dim_sizes)
         return y
+
+
+# array-less pytree registration (tables are static numpy aux)
+from ..linearoperator import register_operator_arrays  # noqa: E402
+register_operator_arrays(MPIHalo)

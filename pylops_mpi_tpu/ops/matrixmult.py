@@ -332,3 +332,10 @@ def MPIMatrixMult(A, M: int, saveAt: bool = False, mesh=None,
                                   saveAt=saveAt, grid=grid,
                                   compute_dtype=compute_dtype)
     raise NotImplementedError("kind must be 'block', 'summa' or 'auto'")
+
+
+# sharded matrix tiles travel into jit as pytree children
+# (multi-process arrays must not be closed over — linearoperator.py)
+from ..linearoperator import register_operator_arrays  # noqa: E402
+for _c in (_MPIBlockMatrixMult, _MPISummaMatrixMult, _MPIAutoMatrixMult):
+    register_operator_arrays(_c, "A", "At")

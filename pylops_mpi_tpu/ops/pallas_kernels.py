@@ -28,8 +28,8 @@ except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
 __all__ = ["first_derivative_centered", "second_derivative",
-           "batched_normal_matvec", "normal_matvec_supported",
-           "pallas_available"]
+           "stencil_taps", "batched_normal_matvec",
+           "normal_matvec_supported", "pallas_available"]
 
 
 def pallas_available() -> bool:
@@ -105,6 +105,45 @@ def second_derivative(x: jax.Array, axis: int = 0,
     v2 = v.reshape(shp[0], -1)
     y2 = _call(partial(_sd_kernel, invs2=1.0 / sampling ** 2), v2)
     return jnp.moveaxis(y2.reshape(shp), 0, axis)
+
+
+def _taps_kernel(x_ref, o_ref, *, taps, w: int, rows: int):
+    """One VMEM pass of an arbitrary static tap stencil: the slab
+    (``rows + 2w`` sublanes) is loaded once and every tap is a shifted
+    slice of the loaded block — XLA-level slicing would reload for
+    each shift."""
+    g = x_ref[:]
+    y = None
+    for d, c in taps:  # static python loop: unrolled at trace time
+        part = g[w + d: w + d + rows] * c
+        y = part if y is None else y + part
+    o_ref[:] = y
+
+
+def stencil_taps(slab: jax.Array, taps, w: int) -> jax.Array:
+    """Apply the pure tap stencil ``y[j] = Σ_d c_d · slab[w + j + d]``
+    to a halo-extended 2-D slab ``(rows + 2w, cols)`` → ``(rows,
+    cols)``, as one Pallas VMEM pass (the generalization of the
+    centered-3 kernels above to every kind/order the explicit
+    distributed stencil path supports — forward/backward, centered-5,
+    second-derivative offsets). ``taps`` is a static sequence of
+    ``(offset, coefficient)`` pairs with ``|offset| <= w``."""
+    rows = slab.shape[0] - 2 * w
+    taps = tuple(taps)
+    if not pallas_available():
+        y = None
+        for d, c in taps:
+            part = slab[w + d: w + d + rows] * c
+            y = part if y is None else y + part
+        return y
+    return pl.pallas_call(
+        partial(_taps_kernel, taps=taps, w=w, rows=rows),
+        out_shape=jax.ShapeDtypeStruct((rows,) + slab.shape[1:],
+                                       slab.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=_interpret(),
+    )(slab)
 
 
 # ------------------------------------------------------- fused normal matvec

@@ -186,3 +186,4 @@ class MPIHStack(MPILinearOperator):
 from ..linearoperator import register_operator_arrays  # noqa: E402
 register_operator_arrays(MPIVStack, "_batched")
 register_operator_arrays(MPIHStack, "vstack")
+register_operator_arrays(MPIStackedVStack, "ops")

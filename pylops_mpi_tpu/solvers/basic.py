@@ -58,7 +58,13 @@ class _BaseSolver:
 
 class CG(_BaseSolver):
     """Conjugate gradient for square distributed operators
-    (ref ``cls_basic.py:12-249``)."""
+    (ref ``cls_basic.py:12-249``).
+
+    The ``setup``/``step``/``run`` class API exists for callback /
+    per-iteration-inspection parity with the reference and syncs 2-3
+    scalars to host EVERY iteration — it is the slow path. The
+    functional :func:`cg` (fused ``lax.while_loop``, default when no
+    callbacks) is the fast path."""
 
     def setup(self, y: Vector, x0: Vector, niter: Optional[int] = None,
               tol: float = 1e-4, show: bool = False) -> Vector:
@@ -127,7 +133,11 @@ class CG(_BaseSolver):
 
 
 class CGLS(_BaseSolver):
-    """Damped least-squares CGLS (ref ``cls_basic.py:252-531``)."""
+    """Damped least-squares CGLS (ref ``cls_basic.py:252-531``).
+
+    Like :class:`CG`, the ``setup``/``step``/``run`` API is the
+    host-synced slow path, provided for callback parity; the functional
+    :func:`cgls` (fused ``lax.while_loop``) is the fast path."""
 
     def setup(self, y: Vector, x0: Vector, niter: Optional[int] = None,
               damp: float = 0.0, tol: float = 1e-4,
@@ -366,10 +376,10 @@ def _get_fused(Op, key, make_builder):
     requires for arrays spanning non-addressable devices (exercised by
     tests/multihost_worker.py). Unregistered operators keep the
     closure form."""
-    from ..linearoperator import OP_ARRAY_PYTREES
+    from ..linearoperator import operator_is_jit_arg
     entry = _FUSED_CACHE.get(key)
     if entry is None:
-        if type(Op) in OP_ARRAY_PYTREES:
+        if operator_is_jit_arg(Op):
             jfn = jax.jit(lambda op, *a, **k: make_builder(op)(*a, **k))
 
             def fn(*a, _jfn=jfn, _op=Op, **k):

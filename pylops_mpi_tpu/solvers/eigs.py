@@ -64,13 +64,12 @@ def power_iteration(Op, b_k: Vector, niter: int = 10, tol: float = 1e-5,
     return maxeig, b_k, iiter + 1
 
 
-def _power_iteration_fused(Op, b_k: Vector, niter: int, tol):
-    """Whole power iteration as one ``lax.while_loop``; the first step
-    runs outside the loop to seed the eigenvalue carry (the eager loop's
-    ``maxeig_old = 0`` first-pass comparison is preserved)."""
-
+def _power_run(op, b_in, niter, tol):
+    """The whole power iteration as one ``lax.while_loop``; the first
+    step runs outside the loop to seed the eigenvalue carry (the eager
+    loop's ``maxeig_old = 0`` first-pass comparison is preserved)."""
     def one_step(b):
-        b1 = Op.matvec(b)
+        b1 = op.matvec(b)
         maxeig = jnp.asarray(b.dot(b1, vdot=True))
         return b1 * (1.0 / b1.norm()), maxeig
 
@@ -83,10 +82,34 @@ def _power_iteration_fused(Op, b_k: Vector, niter: int, tol):
     def cond(state):
         return (state[2] < niter) & (~state[3])
 
-    b_k, maxeig0 = one_step(b_k)
+    b0, maxeig0 = one_step(b_in)
     conv0 = jnp.abs(maxeig0 - 0.0) < tol * jnp.abs(maxeig0)
-    state = (b_k, maxeig0, jnp.asarray(1), conv0)
-    b_k, maxeig, iiter, _ = lax.while_loop(cond, body, state)
+    state = (b0, maxeig0, jnp.asarray(1), conv0)
+    b_out, maxeig, iiter, _ = lax.while_loop(cond, body, state)
+    return b_out, maxeig, iiter
+
+
+# module-level jit: repeated solves on the same operator instance hit
+# the compilation cache (a per-call jax.jit wrapper never would)
+_power_run_jit = None
+
+
+def _power_iteration_fused(Op, b_k: Vector, niter: int, tol):
+    """Registered operator compositions enter the compiled program as a
+    pytree argument — their sharded buffers must not be closed over on
+    multi-process meshes (``linearoperator.operator_is_jit_arg``);
+    anything else (e.g. unregistered user subclasses) runs the eager
+    form, whose ``lax.while_loop`` still compiles with closure
+    capture."""
+    from ..linearoperator import operator_is_jit_arg
+    if operator_is_jit_arg(Op):
+        global _power_run_jit
+        if _power_run_jit is None:
+            import jax
+            _power_run_jit = jax.jit(_power_run)
+        b_k, maxeig, iiter = _power_run_jit(Op, b_k, niter, tol)
+    else:
+        b_k, maxeig, iiter = _power_run(Op, b_k, niter, tol)
     maxeig = complex(np.asarray(maxeig))
     if abs(maxeig.imag) < 1e-12:
         maxeig = maxeig.real

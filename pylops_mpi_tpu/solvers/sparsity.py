@@ -84,7 +84,12 @@ def _apply_thresh(x: Vector, threshf: Callable, thresh) -> Vector:
 
 class ISTA:
     """Iterative Shrinkage-Thresholding Algorithm
-    (ref ``cls_sparsity.py:49-485``)."""
+    (ref ``cls_sparsity.py:49-485``).
+
+    The class ``setup``/``step``/``run`` API syncs 3-4 scalars to host
+    per iteration (monitorres/callback parity with the reference) — it
+    is the slow path; the functional :func:`ista`/:func:`fista` default
+    to the fused on-device loop."""
 
     def __init__(self, Op):
         self.Op = Op

@@ -84,8 +84,17 @@ def main() -> None:
         / np.linalg.norm(want))(ys._arr))
     assert serr < 1e-4, f"SUMMA rel err {serr}"
 
-    print(f"MULTIHOST OK p{pid} cgls_err={err:.2e} summa_err={serr:.2e}",
-          flush=True)
+    # ISTA: drives power_iteration on the lazy Op.H @ Op composition —
+    # the registered-wrapper pytree chain under multi-process jit
+    xsp, nit_i, cost_i = pmt.ista(Op, dy, x0=x0, niter=8, eps=1e-4)
+    ierr = float(jax.jit(
+        lambda a: jnp.linalg.norm(a - jnp.asarray(xt))
+        / np.linalg.norm(xt))(xsp._arr))
+    assert np.isfinite(cost_i).all() and ierr < 0.5, \
+        f"ISTA diverged: err={ierr} cost={cost_i[-3:]}"
+
+    print(f"MULTIHOST OK p{pid} cgls_err={err:.2e} summa_err={serr:.2e} "
+          f"ista_err={ierr:.2e}", flush=True)
 
 
 if __name__ == "__main__":

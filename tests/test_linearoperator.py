@@ -192,3 +192,34 @@ def test_asmpilinearoperator(rng):
     x = _vec(rng, 8)
     dx = DistributedArray.to_dist(x, partition=pmt.Partition.BROADCAST)
     np.testing.assert_allclose(Op.matvec(dx).asarray(), A @ x, rtol=1e-12)
+
+
+def test_unregistered_operator_composition_still_solves(rng):
+    """A user-defined MPILinearOperator subclass (unregistered as a
+    pytree) inside a registered wrapper composition must take the
+    closure path, not crash jit argument flattening — the standard
+    porting pattern (custom operator + ista/power_iteration)."""
+    import pylops_mpi_tpu as pmt
+    from pylops_mpi_tpu.linearoperator import operator_is_jit_arg
+
+    class MyOp(pmt.MPILinearOperator):
+        def __init__(self, n, mesh=None):
+            from pylops_mpi_tpu.parallel.mesh import default_mesh
+            self.mesh = mesh or default_mesh()
+            super().__init__(shape=(n, n), dtype=np.float64)
+
+        def _matvec(self, x):
+            return x * 2.0
+
+        def _rmatvec(self, x):
+            return x * 2.0
+
+    op = MyOp(16)
+    comp = op.H @ op  # registered wrapper over unregistered child
+    assert not operator_is_jit_arg(comp)
+    b0 = DistributedArray.to_dist(np.zeros(16))
+    maxeig, _, _ = pmt.power_iteration(comp, b_k=b0, niter=5)
+    np.testing.assert_allclose(maxeig, 4.0, rtol=1e-6)
+    y = DistributedArray.to_dist(rng.standard_normal(16))
+    x, *_ = pmt.cgls(op, y, niter=10, tol=0.0)
+    np.testing.assert_allclose(x.asarray(), y.asarray() / 2.0, rtol=1e-8)

@@ -134,3 +134,20 @@ def test_blockdiag_compute_dtype_bf16(rng):
     rel_u = np.linalg.norm(u.asarray() - uref.asarray()) \
         / np.linalg.norm(uref.asarray())
     assert rel_u < 2e-2
+
+
+@pytest.mark.parametrize("taps,w", [
+    (((1, 2.0), (0, -2.0)), 1),                      # forward-like
+    (((-1, -0.5), (1, 0.5)), 1),                     # centered-3
+    (((-2, 1 / 12), (-1, -8 / 12), (1, 8 / 12), (2, -1 / 12)), 2),  # c5
+    (((0, 1.0), (1, -2.0), (2, 1.0)), 2),            # SD forward
+])
+def test_stencil_taps_kernel(rng, taps, w):
+    """The generic one-VMEM-pass tap kernel (interpret mode on CPU)
+    matches the plain shifted-slice formulation for every tap pattern
+    the explicit distributed stencil path emits."""
+    from pylops_mpi_tpu.ops.pallas_kernels import stencil_taps
+    slab = rng.standard_normal((40 + 2 * w, 12)).astype(np.float32)
+    got = np.asarray(stencil_taps(jnp.asarray(slab), taps, w))
+    want = sum(c * slab[w + d: w + d + 40] for d, c in taps)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)

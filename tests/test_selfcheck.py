@@ -31,9 +31,9 @@ def test_selfcheck_covers_every_pallas_kernel(selfcheck_result):
     # one check per public pallas entry point + the distributed hot paths
     names = set(selfcheck_result["checks"])
     assert {"pallas_first_derivative", "pallas_second_derivative",
-            "pallas_normal_matvec", "pallas_normal_matvec_bf16",
-            "summa_matmul", "pencil_fft2d", "ring_halo_stencil",
-            "fused_cgls"} <= names
+            "pallas_stencil_taps", "pallas_normal_matvec",
+            "pallas_normal_matvec_bf16", "summa_matmul", "pencil_fft2d",
+            "ring_halo_stencil", "fused_cgls"} <= names
 
 
 def test_probe_log_summary_and_cache_merge(tmp_path):
