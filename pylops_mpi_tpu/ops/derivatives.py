@@ -222,7 +222,9 @@ class _StencilOperator(MPILinearOperator):
         pallas_core = None
         inner_bytes = inner * np.dtype(x.dtype).itemsize
         slab_bytes = (rmax + 2 * w) * inner_bytes
-        if on_tpu and slab_bytes <= 8 << 20:  # half of ~16 MB VMEM
+        # input slab AND output block both live in VMEM (unblocked
+        # call): 2x slab + compiler scratch must fit ~16 MB/core
+        if on_tpu and slab_bytes <= 4 << 20:
             taps_t = tuple(sorted(taps.items()))
 
             def pallas_core(slab, _t=taps_t):

@@ -44,11 +44,22 @@ class MPIFredholm1(MPILinearOperator):
     it selects between per-slice matmul and einsum execution in the
     reference (identical results, ref ``Fredholm1.py:120-131``); here
     the batched einsum on the MXU is always the right schedule.
+
+    ``compute_dtype`` (e.g. ``jnp.complex64`` for a c128 operator,
+    ``jnp.bfloat16`` for a real one) narrows the STORAGE of the
+    kernel — by far the memory hog at ``nsl·nx·ny`` — while vectors
+    and accumulation stay in the operator dtype (the
+    ``MPIBlockDiag(compute_dtype=...)`` HBM-bandwidth lever; the
+    reference's engine has no narrow-storage path).
     """
 
     def __init__(self, G, nz: int = 1, saveGt: bool = False,
-                 usematmul: bool = True, mesh=None, dtype="float64"):
+                 usematmul: bool = True, mesh=None, dtype="float64",
+                 compute_dtype=None):
         G = jnp.asarray(G)
+        self.compute_dtype = compute_dtype
+        if compute_dtype is not None:
+            G = G.astype(compute_dtype)
         self.nz = int(nz)
         self.nsl, self.nx, self.ny = G.shape
         from ..parallel.mesh import default_mesh

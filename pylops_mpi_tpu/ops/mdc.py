@@ -25,11 +25,15 @@ __all__ = ["MPIMDC"]
 
 def MPIMDC(G, nt: int, nv: int, nfreq: Optional[int] = None, dt: float = 1.0,
            dr: float = 1.0, twosided: bool = True, saveGt: bool = True,
-           conj: bool = False, prescaled: bool = False, mesh=None
-           ) -> MPILinearOperator:
+           conj: bool = False, prescaled: bool = False, mesh=None,
+           compute_dtype=None) -> MPILinearOperator:
     """Distributed MDC operator (ref ``MDC.py:82-180``). ``G`` is the
     full frequency-domain kernel ``(nfmax, ns, nr)`` (one controller —
-    the reference passes each rank its frequency chunk)."""
+    the reference passes each rank its frequency chunk).
+    ``compute_dtype`` (e.g. ``jnp.complex64``) narrows the stored
+    kernel — the operator's memory hog — via
+    ``MPIFredholm1(compute_dtype=...)``; FFTs and vectors keep the
+    operator dtype."""
     G = jnp.asarray(G)
     if twosided and nt % 2 == 0:
         raise ValueError("nt must be odd number")
@@ -46,7 +50,8 @@ def MPIMDC(G, nt: int, nv: int, nfreq: Optional[int] = None, dt: float = 1.0,
         nfmax = nfmax_req
 
     scale = 1.0 if prescaled else dr * dt * np.sqrt(nt)
-    Frop = MPIFredholm1(scale * G, nv, saveGt=saveGt, mesh=mesh, dtype=dtype)
+    Frop = MPIFredholm1(scale * G, nv, saveGt=saveGt, mesh=mesh,
+                        dtype=dtype, compute_dtype=compute_dtype)
     if conj:
         Frop = Frop.conj()
 

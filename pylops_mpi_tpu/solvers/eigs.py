@@ -89,25 +89,24 @@ def _power_run(op, b_in, niter, tol):
     return b_out, maxeig, iiter
 
 
-# module-level jit: repeated solves on the same operator instance hit
-# the compilation cache (a per-call jax.jit wrapper never would)
-_power_run_jit = None
-
-
 def _power_iteration_fused(Op, b_k: Vector, niter: int, tol):
     """Registered operator compositions enter the compiled program as a
     pytree argument — their sharded buffers must not be closed over on
     multi-process meshes (``linearoperator.operator_is_jit_arg``);
     anything else (e.g. unregistered user subclasses) runs the eager
-    form, whose ``lax.while_loop`` still compiles with closure
-    capture."""
+    form, whose ``lax.while_loop`` still compiles with closure capture.
+    The compiled program lives in the solvers' bounded LRU
+    (``basic._FUSED_CACHE``), so repeated estimates on one operator hit
+    the cache while churned operators (ista's per-call ``Op.H @ Op``)
+    are eventually evicted together with the buffers they pin —
+    ``clear_fused_cache()`` releases them."""
     from ..linearoperator import operator_is_jit_arg
+    from .basic import _get_fused, _vkey
     if operator_is_jit_arg(Op):
-        global _power_run_jit
-        if _power_run_jit is None:
-            import jax
-            _power_run_jit = jax.jit(_power_run)
-        b_k, maxeig, iiter = _power_run_jit(Op, b_k, niter, tol)
+        fn = _get_fused(Op, (id(Op), "power", _vkey(b_k)),
+                        lambda op: (lambda b, niter_, tol_:
+                                    _power_run(op, b, niter_, tol_)))
+        b_k, maxeig, iiter = fn(b_k, niter, tol)
     else:
         b_k, maxeig, iiter = _power_run(Op, b_k, niter, tol)
     maxeig = complex(np.asarray(maxeig))
