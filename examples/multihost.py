@@ -1,0 +1,91 @@
+"""Multi-host launch template — the analog of the reference's
+``mpiexec -n P`` scripts (see docs/multihost.md and the real
+2-process CI exercise in tests/multihost_worker.py).
+
+On a TPU pod, run THIS SAME script on every host (the cluster env
+provides coordinator/process info); locally you can simulate two
+hosts with:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+      python examples/multihost.py --port 12345 --nproc 2 --pid 0 &
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+      python examples/multihost.py --port 12345 --nproc 2 --pid 1
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port", type=int, default=None,
+                    help="localhost coordinator port (local simulation)")
+    ap.add_argument("--nproc", type=int, default=None)
+    ap.add_argument("--pid", type=int, default=None)
+    # tolerate foreign argv (the examples test runner passes its own)
+    args, _ = ap.parse_known_args()
+
+    if args.port is None and not os.environ.get("COORDINATOR_ADDRESS"):
+        # launch template: without a coordinator (pod env or --port
+        # simulation) there is nothing meaningful to bootstrap
+        print("multihost.py is a launch template — run one copy per "
+              "host on a pod, or simulate locally with --port/--nproc/"
+              "--pid (docs/multihost.md; exercised for real by "
+              "tests/test_multihost.py)")
+        return
+
+    if args.port is not None:  # local simulation needs the CPU platform
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import pylops_mpi_tpu as pmt
+    from pylops_mpi_tpu.ops.local import MatrixMult
+
+    if args.port is not None:
+        pmt.initialize_multihost(
+            coordinator_address=f"localhost:{args.port}",
+            num_processes=args.nproc, process_id=args.pid)
+    else:
+        pmt.initialize_multihost()  # TPU pod: auto-detect
+
+    mesh = pmt.make_mesh_hybrid(dcn_size=jax.process_count())
+    pmt.set_default_mesh(mesh)
+    if jax.process_index() == 0:
+        print(f"{jax.process_count()} processes, "
+              f"{len(jax.devices())} devices, mesh {mesh.devices.shape}")
+
+    # identical data on every process (rule 1 of docs/multihost.md)
+    rng = np.random.default_rng(0)
+    n, nblk = 128, len(jax.devices())
+    blocks = []
+    for _ in range(nblk):
+        b = (rng.standard_normal((n, n)) / np.sqrt(n)).astype(np.float32)
+        np.fill_diagonal(b, b.diagonal() + 4.0)
+        blocks.append(b)
+    xt = rng.standard_normal(nblk * n).astype(np.float32)
+    y = np.concatenate([b @ xt[i * n:(i + 1) * n]
+                        for i, b in enumerate(blocks)])
+
+    Op = pmt.MPIBlockDiag([MatrixMult(b, dtype=np.float32) for b in blocks])
+    dy = pmt.DistributedArray.to_dist(y, mesh=mesh)
+    xs, *_ = pmt.cgls(Op, dy, niter=60, tol=0.0)
+
+    # on-device error to a replicated scalar (rule 2: no host gathers)
+    err = float(jax.jit(
+        lambda a: jnp.linalg.norm(a - jnp.asarray(xt))
+        / np.linalg.norm(xt))(xs._arr))
+    if jax.process_index() == 0:
+        print(f"CGLS rel_err = {err:.2e}")
+
+
+if __name__ == "__main__":
+    main()

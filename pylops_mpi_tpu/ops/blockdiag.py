@@ -110,10 +110,19 @@ class MPIBlockDiag(MPILinearOperator):
             A = self._batched
             nblk, m, n = A.shape
             X = x.array.reshape(nblk, n if forward else m)
-            if forward:
-                Y = jnp.einsum("bmn,bn->bm", A, X)
+            if self.compute_dtype is not None:
+                # narrow BOTH operands, accumulate wide — the explicit
+                # MXU form; leaving X wide would make einsum's type
+                # promotion read A back at the wide dtype
+                out_dt = X.dtype
+                X = X.astype(self.compute_dtype)
+                kw = {"preferred_element_type": out_dt}
             else:
-                Y = jnp.einsum("bnm,bn->bm", A.conj(), X)
+                kw = {}
+            if forward:
+                Y = jnp.einsum("bmn,bn->bm", A, X, **kw)
+            else:
+                Y = jnp.einsum("bnm,bn->bm", A.conj(), X, **kw)
             arr = Y.ravel()
         else:
             offs = np.concatenate([[0], np.cumsum(sizes_in)])

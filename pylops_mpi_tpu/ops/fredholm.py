@@ -126,17 +126,31 @@ class MPIFredholm1(MPILinearOperator):
         y[:] = arr.ravel()
         return y
 
+    def _contract(self, spec, K, v):
+        """Batched contraction honoring ``compute_dtype``: BOTH operands
+        narrow, accumulation in the operator dtype
+        (``preferred_element_type``) — so the kernel is READ at its
+        narrow storage width instead of being promoted to a full-size
+        wide temporary (the vector's narrowing is the usual
+        narrow-inputs/wide-accumulate trade, same as bf16 on the
+        MXU)."""
+        if self.compute_dtype is not None:
+            v = v.astype(self.compute_dtype)
+            return jnp.einsum(spec, K, v,
+                              preferred_element_type=np.dtype(self.dtype))
+        return jnp.einsum(spec, K, v.astype(self.dtype))
+
     def _matvec(self, x: DistributedArray) -> DistributedArray:
         self._check_partition(x, self.ny)
-        m = x.array.reshape(self.dims).astype(self.dtype)
-        d = jnp.einsum("kxy,kyz->kxz", self.G, m)
+        m = x.array.reshape(self.dims)
+        d = self._contract("kxy,kyz->kxz", self.G, m)
         return self._wrap(d, x, self.shape[0], self.nx)
 
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
         self._check_partition(x, self.nx)
-        d = x.array.reshape(self.dimsd).astype(self.dtype)
+        d = x.array.reshape(self.dimsd)
         GT = self.GT if self.GT is not None else jnp.conj(self.G).transpose(0, 2, 1)
-        m = jnp.einsum("kyx,kxz->kyz", GT, d)
+        m = self._contract("kyx,kxz->kyz", GT, d)
         return self._wrap(m, x, self.shape[1], self.ny)
 
 

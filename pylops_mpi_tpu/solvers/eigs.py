@@ -96,10 +96,13 @@ def _power_iteration_fused(Op, b_k: Vector, niter: int, tol):
     anything else (e.g. unregistered user subclasses) runs the eager
     form, whose ``lax.while_loop`` still compiles with closure capture.
     The compiled program lives in the solvers' bounded LRU
-    (``basic._FUSED_CACHE``), so repeated estimates on one operator hit
-    the cache while churned operators (ista's per-call ``Op.H @ Op``)
-    are eventually evicted together with the buffers they pin —
-    ``clear_fused_cache()`` releases them."""
+    (``basic._FUSED_CACHE``): repeated estimates on the SAME operator
+    instance hit the cache; a fresh composition per call retraces
+    either way (pytree aux compares by identity), but the LRU bounds
+    how many churned entries stay pinned and ``clear_fused_cache()``
+    releases them — ista/fista additionally cache the resulting
+    eigenvalue per parent operator so the churn happens at most
+    once."""
     from ..linearoperator import operator_is_jit_arg
     from .basic import _get_fused, _vkey
     if operator_is_jit_arg(Op):

@@ -314,11 +314,28 @@ def _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha, eigsdict, tol,
     if x0 is None:
         raise ValueError("x0 required")
     if alpha is None:
-        Op1 = Op.H @ Op
-        b0 = x0.zeros_like() if isinstance(x0, DistributedArray) else x0.copy()
-        maxeig = np.abs(power_iteration(Op1, b_k=b0, dtype=Op1.dtype,
-                                        **(eigsdict or {}))[0])
-        alpha = float(1.0 / maxeig)
+        # the dominant eigenvalue depends only on Op: cache it so
+        # repeated ista/fista solves on one operator don't re-estimate
+        # (each estimate builds a fresh Op.H @ Op whose power loop
+        # cannot hit any compilation cache — pytree aux compares by
+        # instance identity)
+        ekey = (id(Op), "maxeig",
+                tuple(sorted((eigsdict or {}).items())))
+        from .basic import _FUSED_CACHE, _FUSED_CACHE_MAX
+        hit = _FUSED_CACHE.get(ekey)
+        if hit is not None:
+            alpha = hit[0]
+            _FUSED_CACHE.move_to_end(ekey)
+        else:
+            Op1 = Op.H @ Op
+            b0 = x0.zeros_like() if isinstance(x0, DistributedArray) \
+                else x0.copy()
+            maxeig = np.abs(power_iteration(Op1, b_k=b0, dtype=Op1.dtype,
+                                            **(eigsdict or {}))[0])
+            alpha = float(1.0 / maxeig)
+            _FUSED_CACHE[ekey] = (alpha, Op)
+            if len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
+                _FUSED_CACHE.popitem(last=False)
     decay = np.ones(niter) if decay is None else np.asarray(decay)
     key = (id(Op), "fista" if momentum else "ista", niter, threshkind,
            id(SOp) if SOp is not None else None, len(decay),
