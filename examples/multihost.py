@@ -27,6 +27,9 @@ def main() -> None:
     # tolerate foreign argv (the examples test runner passes its own)
     args, _ = ap.parse_known_args()
 
+    if args.port is not None and (args.nproc is None or args.pid is None):
+        ap.error("--port requires --nproc and --pid (one process per "
+                 "simulated host)")
     if args.port is None and not os.environ.get("COORDINATOR_ADDRESS"):
         # launch template: without a coordinator (pod env or --port
         # simulation) there is nothing meaningful to bootstrap

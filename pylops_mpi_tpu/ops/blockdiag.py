@@ -83,18 +83,26 @@ class MPIBlockDiag(MPILinearOperator):
         self._batched = self._try_batch()
 
     def _try_batch(self):
-        """Homogeneous MatrixMult blocks → stacked batched GEMM.
+        """Homogeneous MatrixMult blocks → stacked batched GEMM, for
+        plain (GEMV) blocks and uniform ``otherdims`` (multi-RHS GEMM)
+        blocks alike — the latter is the GEMV→GEMM lever: one read of
+        the stacked matrices feeds ``k`` columns on the MXU.
 
         ``compute_dtype`` (e.g. ``jnp.bfloat16``) re-stores the stacked
         blocks narrower — on TPU this halves the HBM traffic of the
         memory-bound matvec (the MXU accumulates in f32 regardless);
         vectors and reductions stay in the operator dtype."""
-        if not all(isinstance(op, MatrixMult) and not op.otherdims
-                   for op in self.ops):
+        self._batched_k = 1
+        if not all(isinstance(op, MatrixMult) for op in self.ops):
             return None
+        odims = {op.otherdims for op in self.ops}
+        if len(odims) != 1:
+            return None
+        other = odims.pop()
         shapes = {op.A.shape for op in self.ops}
         if len(shapes) != 1 or len(self.ops) % int(self.mesh.devices.size) != 0:
             return None
+        self._batched_k = int(np.prod(other)) if other else 1
         A = jnp.stack([op.A for op in self.ops])  # (nblk, m, n)
         if self.compute_dtype is not None:
             A = A.astype(self.compute_dtype)
@@ -109,20 +117,22 @@ class MPIBlockDiag(MPILinearOperator):
         if self._batched is not None:
             A = self._batched
             nblk, m, n = A.shape
-            X = x.array.reshape(nblk, n if forward else m)
+            k = self._batched_k
+            X = x.array.reshape(nblk, n if forward else m, k)
             if self.compute_dtype is not None:
-                # narrow BOTH operands, accumulate wide — the explicit
-                # MXU form; leaving X wide would make einsum's type
-                # promotion read A back at the wide dtype
-                out_dt = X.dtype
+                # narrow BOTH operands, accumulate in the OPERATOR
+                # dtype — the explicit MXU form; leaving X wide would
+                # make einsum's type promotion read A back at the wide
+                # dtype, and accumulating in X's dtype would silently
+                # narrow when upstream already produced narrow vectors
                 X = X.astype(self.compute_dtype)
-                kw = {"preferred_element_type": out_dt}
+                kw = {"preferred_element_type": np.dtype(self.dtype)}
             else:
                 kw = {}
             if forward:
-                Y = jnp.einsum("bmn,bn->bm", A, X, **kw)
+                Y = jnp.einsum("bmn,bnk->bmk", A, X, **kw)
             else:
-                Y = jnp.einsum("bnm,bn->bm", A.conj(), X, **kw)
+                Y = jnp.einsum("bnm,bnk->bmk", A.conj(), X, **kw)
             arr = Y.ravel()
         else:
             offs = np.concatenate([[0], np.cumsum(sizes_in)])
@@ -148,7 +158,8 @@ class MPIBlockDiag(MPILinearOperator):
     def has_fused_normal(self) -> bool:
         from .pallas_kernels import normal_matvec_supported
         return (self._batched is not None
-                and len(self.mesh.axis_names) == 1  # shard_map kernel is 1-D
+                and self._batched_k == 1  # Pallas kernel is vector-form
+                and len(self.mesh.axis_names) == 1  # shard_map is 1-D
                 and normal_matvec_supported(self._batched))
 
     def normal_matvec(self, x: DistributedArray):

@@ -69,7 +69,7 @@ def all_to_all_resharding(x: jax.Array, mesh: Mesh,
 
 def cart_halo_extend(block: jax.Array, axis_name: str,
                      grid: Sequence[int], ax: int, hm: int, hp: int,
-                     valid_len) -> jax.Array:
+                     valid_len, array_axis: int = None) -> jax.Array:
     """One axis of a Cartesian-grid halo exchange, for use *inside* a
     ``shard_map`` kernel: extends ``block`` along array axis ``ax`` with
     ``hm`` ghost rows from the minus-neighbour and ``hp`` from the
@@ -88,13 +88,20 @@ def cart_halo_extend(block: jax.Array, axis_name: str,
     Sends only the boundary slabs — this is the neighbour exchange the
     implicit partitioner cannot be trusted to recover from a gather
     formulation, lowered to ``collective-permute`` on ICI.
+
+    ``array_axis`` — the block dimension the ghosts extend, when it
+    differs from the mesh-grid axis ``ax`` (default: the same index,
+    the N-D Cartesian-halo convention where grid dims mirror array
+    dims; ``DistributedArray.ghosted`` shards e.g. array axis 1 over a
+    1-axis mesh grid).
     """
+    a_ax = ax if array_axis is None else array_axis
     g_ax = int(grid[ax])
     if hm == 0 and hp == 0:
         return block
     if g_ax == 1:
         padw = [(0, 0)] * block.ndim
-        padw[ax] = (hm, hp)
+        padw[a_ax] = (hm, hp)
         return jnp.pad(block, padw)
     # flat-rank stride between ax-neighbours in the row-major grid
     stride = int(np.prod([int(g) for g in grid[ax + 1:]]))
@@ -105,17 +112,17 @@ def cart_halo_extend(block: jax.Array, axis_name: str,
     if hm:
         # my valid tail -> plus-neighbour's front ghost
         start = jnp.maximum(valid_len - hm, 0)
-        slab = lax.dynamic_slice_in_dim(block, start, hm, axis=ax)
+        slab = lax.dynamic_slice_in_dim(block, start, hm, axis=a_ax)
         perm = [(r, r + stride) for r in range(n) if coords[r] < g_ax - 1]
         parts.append(lax.ppermute(slab, axis_name, perm))
     parts.append(block)
     if hp:
         # my front rows -> minus-neighbour's back ghost (front rows are
         # valid even for short ragged blocks)
-        slab = lax.slice_in_dim(block, 0, hp, axis=ax)
+        slab = lax.slice_in_dim(block, 0, hp, axis=a_ax)
         perm = [(r, r - stride) for r in range(n) if coords[r] > 0]
         parts.append(lax.ppermute(slab, axis_name, perm))
-    return jnp.concatenate(parts, axis=ax)
+    return jnp.concatenate(parts, axis=a_ax)
 
 
 def halo_slab(block, axis_name: str, n_shards: int, ax: int,
@@ -133,9 +140,10 @@ def halo_slab(block, axis_name: str, n_shards: int, ax: int,
     to the successor is this block's valid tail, but the pad rows
     themselves travel nowhere — scrubbing keeps the slab's unused rows
     zero). Shared by the explicit stencil kernels
-    (``ops/derivatives.py``) and ``DistributedArray.ghosted``."""
-    slab = cart_halo_extend(block, axis_name, (n_shards,), ax, front,
-                            back, valid)
+    (``ops/derivatives.py``) and ``DistributedArray.ghosted``; ``ax``
+    is the ARRAY axis, the mesh is always the 1-D ring."""
+    slab = cart_halo_extend(block, axis_name, (n_shards,), 0, front,
+                            back, valid, array_axis=ax)
     if ragged and back:
         bk = lax.slice_in_dim(slab, front + s_phys, front + s_phys + back,
                               axis=ax)

@@ -265,3 +265,33 @@ def test_vstack_dtypes(rng):
         z = Op.rmatvec(y)
         np.testing.assert_allclose(z.asarray(), dense.conj().T @ (dense @ x),
                                    rtol=rtol * 10, atol=rtol * 10)
+
+
+def test_blockdiag_multirhs_batched(rng):
+    """Uniform otherdims (multi-RHS) MatrixMult blocks ride the batched
+    GEMM fast path — the GEMV->GEMM lever — with values equal to the
+    per-op loop."""
+    k = 3
+    mats = [rng.standard_normal((5, 4)) for _ in range(8)]
+    Op = MPIBlockDiag([MatrixMult(m, otherdims=(k,), dtype=np.float64)
+                       for m in mats])
+    assert Op._batched is not None and Op._batched_k == k
+    x = rng.standard_normal(Op.shape[1])
+    y = rng.standard_normal(Op.shape[0])
+    dx = DistributedArray.to_dist(x, local_shapes=Op.local_shapes_m)
+    dy = DistributedArray.to_dist(y, local_shapes=Op.local_shapes_n)
+    got_f = Op.matvec(dx).asarray()
+    got_a = Op.rmatvec(dy).asarray()
+    Op._batched = None  # force the per-op loop
+    np.testing.assert_allclose(got_f, Op.matvec(dx).asarray(), rtol=1e-12)
+    np.testing.assert_allclose(got_a, Op.rmatvec(dy).asarray(), rtol=1e-12)
+    # dense oracle
+    dense = np.zeros(Op.shape)
+    off_r = off_c = 0
+    for m in mats:
+        blk = np.kron(m, np.eye(k))
+        dense[off_r:off_r + blk.shape[0], off_c:off_c + blk.shape[1]] = blk
+        off_r += blk.shape[0]
+        off_c += blk.shape[1]
+    np.testing.assert_allclose(got_f, dense @ x, rtol=1e-12)
+    np.testing.assert_allclose(got_a, dense.T @ y, rtol=1e-12)
