@@ -43,103 +43,76 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fd_kernel(x_ref, o_ref, *, inv2s: float):
-    """y[i] = (x[i+1] - x[i-1]) * inv2s on rows 1..n-2, zero edges.
-    The row axis is the sublane axis; one VMEM pass."""
-    x = x_ref[:]
-    n = x.shape[0]
-    # pltpu.roll requires non-negative shifts: roll(-1) == roll(n-1)
-    up = pltpu.roll(x, n - 1, 0)
-    dn = pltpu.roll(x, 1, 0)
-    y = (up - dn) * inv2s
-    row = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
-    o_ref[:] = jnp.where((row >= 1) & (row <= n - 2), y, 0.0)
-
-
-def _sd_kernel(x_ref, o_ref, *, invs2: float):
-    x = x_ref[:]
-    n = x.shape[0]
-    up = pltpu.roll(x, n - 1, 0)
-    dn = pltpu.roll(x, 1, 0)
-    y = (up - 2.0 * x + dn) * invs2
-    row = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
-    o_ref[:] = jnp.where((row >= 1) & (row <= n - 2), y, 0.0)
-
-
-def _call(kernel, x2d: jax.Array) -> jax.Array:
-    return pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct(x2d.shape, x2d.dtype),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        interpret=_interpret(),
-    )(x2d)
+def _centered3(x: jax.Array, axis: int, taps) -> jax.Array:
+    """Shared wrapper for the centered-3 conveniences: one
+    :func:`stencil_taps` VMEM pass on the moved/flattened array, edge
+    rows zeroed inside the same pass (pylops ``edge=False``), original
+    layout restored."""
+    v = jnp.moveaxis(x, axis, 0)
+    shp = v.shape
+    if shp[0] < 3:  # too short for the 3-point core: all edge rows
+        return jnp.zeros_like(x)
+    y = stencil_taps(v.reshape(shp[0], -1), taps, 1, out_pad=(1, 1))
+    return jnp.moveaxis(y.reshape(shp), 0, axis)
 
 
 def first_derivative_centered(x: jax.Array, axis: int = 0,
                               sampling: float = 1.0) -> jax.Array:
     """Centered 3-point first derivative along ``axis`` (edge rows zero,
     pylops ``edge=False``), as one Pallas VMEM pass."""
-    if not pallas_available():
-        v = jnp.moveaxis(x, axis, 0)
-        mid = (v[2:] - v[:-2]) / (2 * sampling)
-        y = jnp.pad(mid, [(1, 1)] + [(0, 0)] * (v.ndim - 1))
-        return jnp.moveaxis(y, 0, axis)
-    v = jnp.moveaxis(x, axis, 0)
-    shp = v.shape
-    v2 = v.reshape(shp[0], -1)
-    y2 = _call(partial(_fd_kernel, inv2s=1.0 / (2.0 * sampling)), v2)
-    return jnp.moveaxis(y2.reshape(shp), 0, axis)
+    c = 1.0 / (2.0 * sampling)
+    return _centered3(x, axis, ((-1, -c), (1, c)))
 
 
 def second_derivative(x: jax.Array, axis: int = 0,
                       sampling: float = 1.0) -> jax.Array:
     """3-point second derivative along ``axis`` as one Pallas pass."""
-    if not pallas_available():
-        v = jnp.moveaxis(x, axis, 0)
-        mid = (v[2:] - 2 * v[1:-1] + v[:-2]) / sampling ** 2
-        y = jnp.pad(mid, [(1, 1)] + [(0, 0)] * (v.ndim - 1))
-        return jnp.moveaxis(y, 0, axis)
-    v = jnp.moveaxis(x, axis, 0)
-    shp = v.shape
-    v2 = v.reshape(shp[0], -1)
-    y2 = _call(partial(_sd_kernel, invs2=1.0 / sampling ** 2), v2)
-    return jnp.moveaxis(y2.reshape(shp), 0, axis)
+    c = 1.0 / sampling ** 2
+    return _centered3(x, axis, ((-1, c), (0, -2.0 * c), (1, c)))
 
 
-def _taps_kernel(x_ref, o_ref, *, taps, w: int, rows: int):
+def _taps_kernel(x_ref, o_ref, *, taps, w: int, rows: int, pad):
     """One VMEM pass of an arbitrary static tap stencil: the slab
     (``rows + 2w`` sublanes) is loaded once and every tap is a shifted
     slice of the loaded block — XLA-level slicing would reload for
-    each shift."""
+    each shift. ``pad`` zero rows are written at each end INSIDE the
+    pass (the edge=False convention) so callers need no separate
+    full-output pad copy."""
     g = x_ref[:]
     y = None
     for d, c in taps:  # static python loop: unrolled at trace time
         part = g[w + d: w + d + rows] * c
         y = part if y is None else y + part
+    if pad != (0, 0):
+        y = jnp.pad(y, [pad] + [(0, 0)] * (y.ndim - 1))
     o_ref[:] = y
 
 
-def stencil_taps(slab: jax.Array, taps, w: int) -> jax.Array:
+def stencil_taps(slab: jax.Array, taps, w: int,
+                 out_pad=(0, 0)) -> jax.Array:
     """Apply the pure tap stencil ``y[j] = Σ_d c_d · slab[w + j + d]``
-    to a halo-extended 2-D slab ``(rows + 2w, cols)`` → ``(rows,
-    cols)``, as one Pallas VMEM pass (the generalization of the
-    centered-3 kernels above to every kind/order the explicit
-    distributed stencil path supports — forward/backward, centered-5,
-    second-derivative offsets). ``taps`` is a static sequence of
-    ``(offset, coefficient)`` pairs with ``|offset| <= w``."""
+    to a halo-extended 2-D slab ``(rows + 2w, cols)`` → ``(pad_lo +
+    rows + pad_hi, cols)``, as one Pallas VMEM pass (the
+    generalization of the centered-3 kernels above to every kind/order
+    the explicit distributed stencil path supports — forward/backward,
+    centered-5, second-derivative offsets). ``taps`` is a static
+    sequence of ``(offset, coefficient)`` pairs with ``|offset| <= w``;
+    ``out_pad`` prepends/appends zero rows inside the same pass."""
     rows = slab.shape[0] - 2 * w
     taps = tuple(taps)
+    pad = (int(out_pad[0]), int(out_pad[1]))
     if not pallas_available():
         y = None
         for d, c in taps:
             part = slab[w + d: w + d + rows] * c
             y = part if y is None else y + part
+        if pad != (0, 0):
+            y = jnp.pad(y, [pad] + [(0, 0)] * (y.ndim - 1))
         return y
     return pl.pallas_call(
-        partial(_taps_kernel, taps=taps, w=w, rows=rows),
-        out_shape=jax.ShapeDtypeStruct((rows,) + slab.shape[1:],
-                                       slab.dtype),
+        partial(_taps_kernel, taps=taps, w=w, rows=rows, pad=pad),
+        out_shape=jax.ShapeDtypeStruct(
+            (pad[0] + rows + pad[1],) + slab.shape[1:], slab.dtype),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         interpret=_interpret(),

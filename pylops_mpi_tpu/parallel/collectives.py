@@ -158,31 +158,11 @@ def ring_halo_extend(block, axis_name: str, n_shards: int,
     local ``block`` along array axis 0 with the predecessor's last
     ``front`` rows and the successor's first ``back`` rows, zero-filled
     at the domain edges — one ``ppermute`` hop per direction, boundary
-    slabs only. The structural analog of ring attention's neighbour pass
-    and the explicit form of the ghost-cell Send/Recv chain in ref
-    ``pylops_mpi/DistributedArray.py:877-954``. Call inside a
-    ``shard_map`` kernel (production consumer: the stencil fast path in
-    ``ops/derivatives.py``; the N-D generalisation is
-    :func:`cart_halo_extend`)."""
-    n = int(n_shards)
-    if (front == 0 and back == 0):
-        return block
-    if n == 1:
-        padw = [(front, back)] + [(0, 0)] * (block.ndim - 1)
-        return jnp.pad(block, padw)
-    idx = lax.axis_index(axis_name)
-    parts = []
-    if front:
-        fwd = [(i, i + 1) for i in range(n - 1)]
-        recv = lax.ppermute(block[-front:], axis_name, fwd)
-        parts.append(jnp.where(
-            (idx == 0) * jnp.ones((1,) * block.ndim, dtype=bool),
-            jnp.zeros_like(recv), recv))
-    parts.append(block)
-    if back:
-        bwd = [(i, i - 1) for i in range(1, n)]
-        recv = lax.ppermute(block[:back], axis_name, bwd)
-        parts.append(jnp.where(
-            (idx == n - 1) * jnp.ones((1,) * block.ndim, dtype=bool),
-            jnp.zeros_like(recv), recv))
-    return jnp.concatenate(parts, axis=0)
+    slabs only. The structural analog of ring attention's neighbour
+    pass and the explicit form of the ghost-cell Send/Recv chain in
+    ref ``pylops_mpi/DistributedArray.py:877-954``. The 1-D
+    un-padded special case of :func:`cart_halo_extend` (which the
+    production stencil/ghost kernels reach through
+    :func:`halo_slab`)."""
+    return cart_halo_extend(block, axis_name, (int(n_shards),), 0,
+                            front, back, valid_len=block.shape[0])

@@ -106,9 +106,9 @@ def _power_iteration_fused(Op, b_k: Vector, niter: int, tol):
     from ..linearoperator import operator_is_jit_arg
     from .basic import _get_fused, _vkey
     if operator_is_jit_arg(Op):
+        from functools import partial
         fn = _get_fused(Op, (id(Op), "power", _vkey(b_k)),
-                        lambda op: (lambda b, niter_, tol_:
-                                    _power_run(op, b, niter_, tol_)))
+                        lambda op: partial(_power_run, op))
         b_k, maxeig, iiter = fn(b_k, niter, tol)
     else:
         b_k, maxeig, iiter = _power_run(Op, b_k, niter, tol)

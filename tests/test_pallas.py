@@ -151,3 +151,23 @@ def test_stencil_taps_kernel(rng, taps, w):
     got = np.asarray(stencil_taps(jnp.asarray(slab), taps, w))
     want = sum(c * slab[w + d: w + d + 40] for d, c in taps)
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_stencil_taps_out_pad_and_short_axis(rng):
+    """out_pad writes the zero edge rows inside the kernel pass, and
+    the centered-3 wrappers handle axis lengths < 3 (all edge rows)."""
+    from pylops_mpi_tpu.ops.pallas_kernels import (
+        stencil_taps, first_derivative_centered, second_derivative)
+    slab = rng.standard_normal((12, 5)).astype(np.float32)
+    taps = ((-1, -0.5), (1, 0.5))
+    got = np.asarray(stencil_taps(jnp.asarray(slab), taps, 1,
+                                  out_pad=(1, 1)))
+    want = np.zeros((12, 5), np.float32)
+    want[1:-1] = 0.5 * (slab[2:] - slab[:-2])
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    for n in (1, 2):
+        x = rng.standard_normal((n, 4)).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(first_derivative_centered(jnp.asarray(x))), 0.0)
+        np.testing.assert_array_equal(
+            np.asarray(second_derivative(jnp.asarray(x))), 0.0)
