@@ -1,0 +1,43 @@
+"""The one implementation of the narrow-storage contraction rule.
+
+``compute_dtype`` operators (bf16 / complex64 tiles) must contract
+with BOTH operands narrow and accumulate in the operator dtype via
+``preferred_element_type`` — einsum's type promotion would otherwise
+read the narrow buffer back at the wide dtype (potentially
+materializing a full-size wide temporary), defeating the HBM-bandwidth
+lever. Shared by MPIBlockDiag, MPIVStack/MPIHStack and MPIFredholm1.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["einsum_narrow", "check_compute_dtype"]
+
+
+def check_compute_dtype(compute_dtype, op_dtype, where: str) -> None:
+    """Reject real-narrow storage of complex operators — the cast
+    would silently discard imaginary parts (complex64 narrowing of a
+    complex128 operator is fine)."""
+    if compute_dtype is None:
+        return
+    if jnp.issubdtype(np.dtype(op_dtype), np.complexfloating) and \
+            not jnp.issubdtype(jnp.dtype(compute_dtype),
+                               jnp.complexfloating):
+        raise ValueError(
+            f"{where}: compute_dtype={jnp.dtype(compute_dtype).name} "
+            f"would discard the imaginary part of a "
+            f"{np.dtype(op_dtype).name} operator; use a complex "
+            "compute_dtype (e.g. complex64) or drop it")
+
+
+def einsum_narrow(spec: str, A, v, compute_dtype, out_dtype):
+    """``jnp.einsum(spec, A, v)`` honoring the narrow-storage rule.
+    ``A`` is already stored at ``compute_dtype`` (or the operator dtype
+    when ``compute_dtype`` is None); ``v`` is narrowed to match and the
+    contraction accumulates in ``out_dtype``."""
+    if compute_dtype is None:
+        return jnp.einsum(spec, A, v)
+    return jnp.einsum(spec, A, v.astype(compute_dtype),
+                      preferred_element_type=np.dtype(out_dtype))

@@ -105,6 +105,9 @@ class MPIBlockDiag(MPILinearOperator):
         self._batched_k = int(np.prod(other)) if other else 1
         A = jnp.stack([op.A for op in self.ops])  # (nblk, m, n)
         if self.compute_dtype is not None:
+            from ._precision import check_compute_dtype
+            check_compute_dtype(self.compute_dtype, A.dtype,
+                                "MPIBlockDiag")
             A = A.astype(self.compute_dtype)
         from ..parallel.mesh import axis_sharding
         return jax.device_put(A, axis_sharding(self.mesh, 3, 0))
@@ -115,24 +118,17 @@ class MPIBlockDiag(MPILinearOperator):
         locals_out = self.local_shapes_n if forward else self.local_shapes_m
         y_shape = self.shape[0] if forward else self.shape[1]
         if self._batched is not None:
+            from ._precision import einsum_narrow
             A = self._batched
             nblk, m, n = A.shape
             k = self._batched_k
             X = x.array.reshape(nblk, n if forward else m, k)
-            if self.compute_dtype is not None:
-                # narrow BOTH operands, accumulate in the OPERATOR
-                # dtype — the explicit MXU form; leaving X wide would
-                # make einsum's type promotion read A back at the wide
-                # dtype, and accumulating in X's dtype would silently
-                # narrow when upstream already produced narrow vectors
-                X = X.astype(self.compute_dtype)
-                kw = {"preferred_element_type": np.dtype(self.dtype)}
-            else:
-                kw = {}
             if forward:
-                Y = jnp.einsum("bmn,bnk->bmk", A, X, **kw)
+                Y = einsum_narrow("bmn,bnk->bmk", A, X,
+                                  self.compute_dtype, self.dtype)
             else:
-                Y = jnp.einsum("bnm,bnk->bmk", A.conj(), X, **kw)
+                Y = einsum_narrow("bnm,bnk->bmk", A.conj(), X,
+                                  self.compute_dtype, self.dtype)
             arr = Y.ravel()
         else:
             offs = np.concatenate([[0], np.cumsum(sizes_in)])

@@ -128,17 +128,12 @@ class MPIFredholm1(MPILinearOperator):
 
     def _contract(self, spec, K, v):
         """Batched contraction honoring ``compute_dtype``: BOTH operands
-        narrow, accumulation in the operator dtype
-        (``preferred_element_type``) — so the kernel is READ at its
-        narrow storage width instead of being promoted to a full-size
-        wide temporary (the vector's narrowing is the usual
-        narrow-inputs/wide-accumulate trade, same as bf16 on the
-        MXU)."""
-        if self.compute_dtype is not None:
-            v = v.astype(self.compute_dtype)
-            return jnp.einsum(spec, K, v,
-                              preferred_element_type=np.dtype(self.dtype))
-        return jnp.einsum(spec, K, v.astype(self.dtype))
+        narrow, accumulation in the operator dtype (the shared
+        narrow-storage rule, :mod:`ops._precision`)."""
+        from ._precision import einsum_narrow
+        if self.compute_dtype is None:
+            v = v.astype(self.dtype)
+        return einsum_narrow(spec, K, v, self.compute_dtype, self.dtype)
 
     def _matvec(self, x: DistributedArray) -> DistributedArray:
         self._check_partition(x, self.ny)

@@ -21,6 +21,7 @@ from ..distributedarray import DistributedArray, Partition
 from ..stacked import StackedDistributedArray
 from ..linearoperator import MPILinearOperator
 from ..stackedlinearoperator import MPIStackedLinearOperator
+from ._precision import check_compute_dtype, einsum_narrow
 from .local import LocalOperator
 
 __all__ = ["MPIVStack", "MPIStackedVStack", "MPIHStack"]
@@ -86,6 +87,7 @@ class MPIVStack(MPILinearOperator):
             return None, False
         A = jnp.stack(mats)  # (nblk, m, n)
         if self.compute_dtype is not None:
+            check_compute_dtype(self.compute_dtype, A.dtype, "MPIVStack")
             A = A.astype(self.compute_dtype)
         from ..parallel.mesh import axis_sharding
         return jax.device_put(A, axis_sharding(self.mesh, 3, 0)), adjs[0]
@@ -99,9 +101,11 @@ class MPIVStack(MPILinearOperator):
             # replicated x against the block-sharded stack: zero
             # communication, output lands SCATTER over blocks
             if adj:
-                Y = jnp.einsum("bmn,m->bn", A.conj(), xg)
+                Y = einsum_narrow("bmn,m->bn", A.conj(), xg,
+                                  self.compute_dtype, self.dtype)
             else:
-                Y = jnp.einsum("bmn,n->bm", A, xg)
+                Y = einsum_narrow("bmn,n->bm", A, xg,
+                                  self.compute_dtype, self.dtype)
             arr = Y.ravel()
         else:
             arr = jnp.concatenate([op.matvec(xg) for op in self.ops])
@@ -120,11 +124,13 @@ class MPIVStack(MPILinearOperator):
             # the partitioner lowers the contraction to one psum, the
             # reference's sum-allreduce (ref VStack.py:135-150)
             if adj:
-                Y = x.array.reshape(nblk, A.shape[2])
-                acc = jnp.einsum("bmn,bn->m", A, Y)
+                acc = einsum_narrow("bmn,bn->m", A,
+                                    x.array.reshape(nblk, A.shape[2]),
+                                    self.compute_dtype, self.dtype)
             else:
-                Y = x.array.reshape(nblk, A.shape[1])
-                acc = jnp.einsum("bmn,bm->n", A.conj(), Y)
+                acc = einsum_narrow("bmn,bm->n", A.conj(),
+                                    x.array.reshape(nblk, A.shape[1]),
+                                    self.compute_dtype, self.dtype)
         else:
             offs = np.concatenate([[0], np.cumsum(self.nops)])
             acc = None

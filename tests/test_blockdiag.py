@@ -295,3 +295,67 @@ def test_blockdiag_multirhs_batched(rng):
         off_c += blk.shape[1]
     np.testing.assert_allclose(got_f, dense @ x, rtol=1e-12)
     np.testing.assert_allclose(got_a, dense.T @ y, rtol=1e-12)
+
+
+def test_vstack_compute_dtype_bf16(rng):
+    """compute_dtype on VStack/HStack: narrow stacked storage, wide
+    accumulation (mirrors the MPIBlockDiag lever)."""
+    import jax.numpy as jnp
+    mats = [rng.standard_normal((4, 12)).astype(np.float32)
+            for _ in range(8)]
+    Op32 = MPIVStack([MatrixMult(m, dtype=np.float32) for m in mats])
+    Opbf = MPIVStack([MatrixMult(m, dtype=np.float32) for m in mats],
+                     compute_dtype=jnp.bfloat16)
+    assert Opbf._batched.dtype == jnp.bfloat16
+    x = rng.standard_normal(12).astype(np.float32)
+    dx = DistributedArray.to_dist(x, partition=Partition.BROADCAST)
+    y32 = Op32.matvec(dx)
+    ybf = Opbf.matvec(dx)
+    assert ybf.dtype == np.float32  # wide accumulation
+    rel = np.linalg.norm(ybf.asarray() - y32.asarray()) \
+        / np.linalg.norm(y32.asarray())
+    assert 0 < rel < 2e-2
+    dy = DistributedArray.to_dist(
+        rng.standard_normal(32).astype(np.float32),
+        local_shapes=Op32.local_shapes_n)
+    abf = Opbf.rmatvec(dy)
+    assert abf.dtype == np.float32
+    rel_a = np.linalg.norm(abf.asarray() - Op32.rmatvec(dy).asarray()) \
+        / np.linalg.norm(Op32.rmatvec(dy).asarray())
+    assert rel_a < 2e-2
+
+
+def test_hstack_compute_dtype_and_complex_guard(rng):
+    """The adjoint-stacked (HStack) compute_dtype branches, plus the
+    real-narrow-of-complex guard that prevents silent imaginary-part
+    loss (shared rule in ops/_precision.py)."""
+    import jax.numpy as jnp
+    import pytest as _pytest
+    mats = [rng.standard_normal((12, 4)).astype(np.float32)
+            for _ in range(8)]
+    Op32 = MPIHStack([MatrixMult(m, dtype=np.float32) for m in mats])
+    Opbf = MPIHStack([MatrixMult(m, dtype=np.float32) for m in mats],
+                     compute_dtype=jnp.bfloat16)
+    assert Opbf.vstack._batched_adj is True
+    x = rng.standard_normal(32).astype(np.float32)
+    dx = DistributedArray.to_dist(x)
+    ybf = Opbf.matvec(dx)
+    assert ybf.dtype == np.float32
+    rel = np.linalg.norm(ybf.asarray() - Op32.matvec(dx).asarray()) \
+        / np.linalg.norm(Op32.matvec(dx).asarray())
+    assert 0 < rel < 2e-2
+    db = DistributedArray.to_dist(rng.standard_normal(12).astype(np.float32),
+                                  partition=Partition.BROADCAST)
+    abf = Opbf.rmatvec(db)
+    assert abf.dtype == np.float32
+    rel_a = np.linalg.norm(abf.asarray() - Op32.rmatvec(db).asarray()) \
+        / np.linalg.norm(Op32.rmatvec(db).asarray())
+    assert rel_a < 2e-2
+    # bf16 storage of complex blocks must raise, not corrupt
+    cmats = [m + 1j * m for m in mats]
+    with _pytest.raises(ValueError, match="imaginary"):
+        MPIVStack([MatrixMult(m, dtype=np.complex64) for m in cmats],
+                  compute_dtype=jnp.bfloat16)
+    with _pytest.raises(ValueError, match="imaginary"):
+        MPIBlockDiag([MatrixMult(m, dtype=np.complex64) for m in cmats],
+                     compute_dtype=jnp.bfloat16)
