@@ -213,18 +213,12 @@ class _StencilOperator(MPILinearOperator):
                    else [(i, o, c) for (o, i, c) in spec["edge"]])
         import jax as _jax
         on_tpu = _jax.default_backend() == "tpu"
-        # any tap set runs as ONE fused Pallas VMEM pass on TPU (the
-        # slab is loaded once; every tap is a shifted slice of the
-        # loaded block) — but ONLY when the whole slab fits the VMEM
-        # budget: the unblocked pallas_call would fail Mosaic
-        # compilation on bigger shards, where the jnp slice form (XLA
-        # fuses the shifts) handles any size
+        # any tap set runs as a fused Pallas VMEM pass on TPU, tiled
+        # over the column (lane) axis for wide shards; stencil_taps
+        # itself falls back to the identical jnp slice form for shapes
+        # it cannot tile, so no external size gate is needed
         pallas_core = None
-        inner_bytes = inner * np.dtype(x.dtype).itemsize
-        slab_bytes = (rmax + 2 * w) * inner_bytes
-        # input slab AND output block both live in VMEM (unblocked
-        # call): 2x slab + compiler scratch must fit ~16 MB/core
-        if on_tpu and slab_bytes <= 4 << 20:
+        if on_tpu:
             taps_t = tuple(sorted(taps.items()))
 
             def pallas_core(slab, _t=taps_t):

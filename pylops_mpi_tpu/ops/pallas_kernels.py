@@ -88,20 +88,45 @@ def _taps_kernel(x_ref, o_ref, *, taps, w: int, rows: int, pad):
     o_ref[:] = y
 
 
+# per-block VMEM budget for the tiled stencil (input + output block
+# both resident, double-buffered by the pipeline)
+_STENCIL_TILE_BYTES = 2 << 20
+
+
+def _stencil_col_tile(nrows: int, cols: int, itemsize: int) -> int:
+    """Widest 128-lane-aligned column tile whose input block fits the
+    VMEM budget (the whole slab when it fits); 0 when even one
+    lane-width strip does not fit (caller falls back to the XLA slice
+    form). The tile need not divide ``cols`` — the grid uses ceiling
+    division and Mosaic masks the ragged last block (columns carry no
+    stencil dependency, so masked lanes are simply unused)."""
+    max_cols = _STENCIL_TILE_BYTES // max(nrows * itemsize, 1)
+    if cols <= max_cols:
+        return cols
+    return (max_cols // 128) * 128
+
+
 def stencil_taps(slab: jax.Array, taps, w: int,
                  out_pad=(0, 0)) -> jax.Array:
     """Apply the pure tap stencil ``y[j] = Σ_d c_d · slab[w + j + d]``
     to a halo-extended 2-D slab ``(rows + 2w, cols)`` → ``(pad_lo +
-    rows + pad_hi, cols)``, as one Pallas VMEM pass (the
-    generalization of the centered-3 kernels above to every kind/order
-    the explicit distributed stencil path supports — forward/backward,
-    centered-5, second-derivative offsets). ``taps`` is a static
-    sequence of ``(offset, coefficient)`` pairs with ``|offset| <= w``;
-    ``out_pad`` prepends/appends zero rows inside the same pass."""
-    rows = slab.shape[0] - 2 * w
+    rows + pad_hi, cols)``, as a Pallas VMEM pass (the generalization
+    of the centered-3 kernels above to every kind/order the explicit
+    distributed stencil path supports — forward/backward, centered-5,
+    second-derivative offsets). Wide slabs are tiled over the column
+    (lane) axis — columns carry no stencil dependency, so the grid is
+    embarrassingly parallel and arbitrarily wide shards stay on the
+    fused path instead of falling back to XLA slices. ``taps`` is a
+    static sequence of ``(offset, coefficient)`` pairs with
+    ``|offset| <= w``; ``out_pad`` prepends/appends zero rows inside
+    the same pass."""
+    nrows = slab.shape[0]
+    rows = nrows - 2 * w
     taps = tuple(taps)
     pad = (int(out_pad[0]), int(out_pad[1]))
-    if not pallas_available():
+    cols = int(np.prod(slab.shape[1:])) if slab.ndim > 1 else 1
+    tile = _stencil_col_tile(nrows, cols, slab.dtype.itemsize)
+    if not pallas_available() or tile == 0:
         y = None
         for d, c in taps:
             part = slab[w + d: w + d + rows] * c
@@ -109,14 +134,18 @@ def stencil_taps(slab: jax.Array, taps, w: int,
         if pad != (0, 0):
             y = jnp.pad(y, [pad] + [(0, 0)] * (y.ndim - 1))
         return y
-    return pl.pallas_call(
+    shp = slab.shape
+    slab2 = slab.reshape(nrows, cols)
+    out_rows = pad[0] + rows + pad[1]
+    y2 = pl.pallas_call(
         partial(_taps_kernel, taps=taps, w=w, rows=rows, pad=pad),
-        out_shape=jax.ShapeDtypeStruct(
-            (pad[0] + rows + pad[1],) + slab.shape[1:], slab.dtype),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        grid=((cols + tile - 1) // tile,),
+        in_specs=[pl.BlockSpec((nrows, tile), lambda j: (0, j))],
+        out_specs=pl.BlockSpec((out_rows, tile), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((out_rows, cols), slab.dtype),
         interpret=_interpret(),
-    )(slab)
+    )(slab2)
+    return y2.reshape((out_rows,) + shp[1:])
 
 
 # ------------------------------------------------------- fused normal matvec
