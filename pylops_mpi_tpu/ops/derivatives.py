@@ -222,9 +222,8 @@ class _StencilOperator(MPILinearOperator):
             taps_t = tuple(sorted(taps.items()))
 
             def pallas_core(slab, _t=taps_t):
-                flat = slab.reshape(slab.shape[0], -1)
-                out = stencil_taps(flat, _t, w)
-                return out.reshape((rmax,) + slab.shape[1:])
+                # stencil_taps flattens/restores trailing dims itself
+                return stencil_taps(slab, _t, w)
         valid_tab = jnp.asarray(rows_tab, dtype=jnp.int32)
         base_tab = jnp.asarray(np.concatenate([[0], np.cumsum(rows_tab)[:-1]]),
                                dtype=jnp.int32)

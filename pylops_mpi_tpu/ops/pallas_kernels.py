@@ -88,8 +88,10 @@ def _taps_kernel(x_ref, o_ref, *, taps, w: int, rows: int, pad):
     o_ref[:] = y
 
 
-# per-block VMEM budget for the tiled stencil (input + output block
-# both resident, double-buffered by the pipeline)
+# INPUT-block share of the tiled stencil's VMEM budget. True per-step
+# footprint is ~4x this: input block + similarly-sized output block,
+# each double-buffered by the pipeline — so 2 MB here means ~8 MB of
+# the ~16 MB/core VMEM, leaving headroom for compiler scratch.
 _STENCIL_TILE_BYTES = 2 << 20
 
 

@@ -408,3 +408,25 @@ def test_gradient_3d(rng):
     got = Gop.rmatvec(y).asarray()
     expected = sum(D[ax].T @ (D[ax] @ x) for ax in range(3))
     np.testing.assert_allclose(got, expected, rtol=1e-10, atol=1e-10)
+
+
+def test_laplacian_gradient_hlo_schedule(rng):
+    """Laplacian and Gradient (implicit GSPMD formulations over the
+    fused multi-axis stencils) must also lower to boundary
+    collective-permutes with no all-gather — completing the HLO
+    schedule pins across the derivative family."""
+    import jax
+    dims = (64, 4)
+    x = rng.standard_normal(int(np.prod(dims)))
+    dx = DistributedArray.to_dist(x)
+    L = MPILaplacian(dims, axes=(0, 1), dtype=np.float64)
+    for f in (lambda v: L.matvec(v)._arr, lambda v: L.rmatvec(v)._arr):
+        hlo = jax.jit(f).lower(dx).compile().as_text()
+        assert "collective-permute" in hlo
+        assert "all-gather" not in hlo
+    G = MPIGradient(dims, dtype=np.float64)
+    hg = jax.jit(
+        lambda v: [d._arr for d in G.matvec(v).distarrays]
+    ).lower(dx).compile().as_text()
+    assert "collective-permute" in hg
+    assert "all-gather" not in hg
