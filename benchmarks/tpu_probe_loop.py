@@ -93,40 +93,121 @@ def _stage_selfcheck(env):
         cwd=_ROOT)
 
 
-def _stage_flagship(env, small: bool):
+def _stage_diag(env):
+    """Piecewise on-hardware diagnosis (benchmarks/tpu_diag.py): full
+    tracebacks for anything the selfcheck flagged, plus on-hardware
+    validation of fixes made since the last window. Output is the list
+    of step results."""
+    import subprocess
+    try:
+        p = subprocess.run(
+            [sys.executable, "-u", os.path.join(_HERE, "tpu_diag.py")],
+            capture_output=True, text=True, cwd=_ROOT, env=env,
+            timeout=int(os.environ.get("PROBE_DIAG_TIMEOUT", "900")))
+        steps, backend = [], None
+        for line in (p.stdout or "").splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    e = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "backend" in e:
+                    backend = e["backend"]
+                else:
+                    steps.append(e)
+        if not steps:
+            return None, (f"rc={p.returncode}: {(p.stderr or '')[-200:]}")
+        # platform comes from the script's own backend report: a silent
+        # CPU fallback must not be cached (or merged) as hardware
+        # evidence, and a nonzero rc means steps are missing — record
+        # the error so the stage re-runs next window
+        result = {"steps": steps, "rc": p.returncode,
+                  "platform": backend or "unknown"}
+        err = None if p.returncode == 0 else \
+            f"rc={p.returncode}: {(p.stderr or '')[-200:]}"
+        return result, err
+    except subprocess.TimeoutExpired as e:
+        # keep whatever steps made it to stdout before the hang, but
+        # flag the stage errored so it re-runs on the next window
+        steps = []
+        for line in ((e.stdout or b"").decode("utf-8", "replace")
+                     if isinstance(e.stdout, bytes) else (e.stdout or "")
+                     ).splitlines():
+            if line.strip().startswith("{"):
+                try:
+                    steps.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+        return ({"steps": steps, "timeout": True} if steps else None,
+                "diag timeout" if steps else "diag timeout with no steps")
+
+
+def _stage_flagship(env, size: str):
     env = dict(env)
-    if small:
+    if size == "small":
         env["BENCH_NBLOCK_PYLOPS_MPI_TPU"] = "1024"
         env["BENCH_NITER_PYLOPS_MPI_TPU"] = "20"
         env["BENCH_COMPONENTS_PYLOPS_MPI_TPU"] = "0"
         env["BENCH_SELFCHECK_PYLOPS_MPI_TPU"] = "0"  # stage 1 covers it
         timeout = int(os.environ.get("PROBE_SMALL_TIMEOUT", "900"))
+    elif size == "mid":
+        # banked mid-size headline: big enough to mean something
+        # (2048² blocks), cheap enough to survive a short window;
+        # components/selfcheck stay off (own stages cover them)
+        env["BENCH_NBLOCK_PYLOPS_MPI_TPU"] = "2048"
+        env["BENCH_NITER_PYLOPS_MPI_TPU"] = "30"
+        env["BENCH_COMPONENTS_PYLOPS_MPI_TPU"] = "0"
+        env["BENCH_SELFCHECK_PYLOPS_MPI_TPU"] = "0"
+        timeout = int(os.environ.get("PROBE_MID_TIMEOUT", "1200"))
     else:
-        timeout = int(os.environ.get("PROBE_FULL_TIMEOUT", "2400"))
+        timeout = int(os.environ.get("PROBE_FULL_TIMEOUT", "3000"))
     return _bench_mod()._run_json_cmd(
         [sys.executable, os.path.join(_ROOT, "bench.py"), "--child"],
         env, timeout=timeout, cwd=_ROOT)
 
 
+def _code_rev() -> str:
+    import subprocess
+    try:
+        h = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                           capture_output=True, text=True, cwd=_ROOT,
+                           timeout=10).stdout.strip()
+        d = subprocess.run(["git", "status", "--porcelain"],
+                           capture_output=True, text=True, cwd=_ROOT,
+                           timeout=10).stdout.strip()
+        return h + ("+dirty" if d else "")
+    except Exception:
+        return "unknown"
+
+
 def harvest(cache: dict) -> dict:
     """One live window: run whatever stages aren't cached yet; persist
-    after each. Returns the updated cache."""
+    after each. Returns the updated cache. Cached entries are keyed to
+    the git revision that produced them — a stage harvested from older
+    code re-runs so fixes get re-validated on hardware (the flagship
+    artifact-merge in bench.py still falls back to any-rev cached TPU
+    numbers, old beats none)."""
     env = dict(os.environ)
+    rev = _code_rev()
     stages = [
         ("selfcheck", lambda: _stage_selfcheck(env)),
-        ("flagship_small", lambda: _stage_flagship(env, small=True)),
-        ("flagship_full", lambda: _stage_flagship(env, small=False)),
+        ("diag", lambda: _stage_diag(env)),
+        ("flagship_small", lambda: _stage_flagship(env, "small")),
+        ("flagship_mid", lambda: _stage_flagship(env, "mid")),
+        ("flagship_full", lambda: _stage_flagship(env, "full")),
     ]
     for name, runner in stages:
         prev = cache.get(name)
         if prev and prev.get("result") is not None and \
                 prev["result"].get("platform", "tpu") == "tpu" and \
-                not prev.get("error"):
-            continue  # already harvested on an earlier window
+                not prev.get("error") and \
+                prev.get("code_rev") == rev:
+            continue  # harvested on an earlier window, same code
         t0 = time.time()
         result, err = runner()
         entry = {"ts": _now(), "seconds": round(time.time() - t0, 1),
-                 "result": result}
+                 "result": result, "code_rev": rev}
         if err:
             entry["error"] = err
         cache[name] = entry
@@ -161,9 +242,12 @@ def main() -> None:
             # platform must really be "tpu": a tunnel drop mid-stage
             # makes the child silently fall back to cpu, and that cache
             # entry will (rightly) not be promoted by bench.py — keep
-            # probing for a real window instead of declaring victory
+            # probing for a real window instead of declaring victory.
+            # The rev must match too: a full flagship from older code
+            # must not stop the daemon from re-validating current code.
             if (res is not None and not full.get("error")
-                    and res.get("platform") == "tpu"):
+                    and res.get("platform") == "tpu"
+                    and full.get("code_rev") == _code_rev()):
                 _log({"status": "complete",
                       "note": "full TPU flagship cached; daemon exiting"})
                 return

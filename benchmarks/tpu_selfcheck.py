@@ -137,6 +137,16 @@ def run_selfcheck() -> dict:
         return _rel_err(got, want)
     checks["pallas_stencil_taps"] = _check(taps)
 
+    # NOTE on ordering: the FFT check runs LAST. On the remote-tunnel
+    # TPU backend a runtime UNIMPLEMENTED (e.g. a missing FFT
+    # custom-call) wedges the process — every later dispatch also
+    # returns UNIMPLEMENTED (observed round 3: ring/cgls failed after
+    # fft in this process but passed in fresh ones). Keeping the
+    # wedge-prone check at the end makes every other verdict
+    # trustworthy; ``post_fft_canary`` records whether the process was
+    # wedged so a dead-fft artifact can be told apart from real
+    # failures.
+
     # --- SUMMA shard_map GEMM (forward + adjoint) vs dense NumPy
     def summa():
         A = rng.standard_normal((192, 160)).astype(np.float32)
@@ -149,18 +159,6 @@ def run_selfcheck() -> dict:
         e2 = _rel_err(w.asarray(), (A.T @ z.reshape(192, 48)).ravel())
         return max(e1, e2)
     checks["summa_matmul"] = _check(summa)
-
-    # --- ragged pencil FFT2D (explicit all_to_all kernel) vs NumPy
-    def fft():
-        dims = (100, 64)  # 100 % n_dev != 0 for n_dev in {3,6,8}: ragged
-        Op = pmt.MPIFFT2D(dims=dims, dtype=np.complex64)
-        x = (rng.standard_normal(dims) + 1j * rng.standard_normal(dims)
-             ).astype(np.complex64)
-        y = Op @ pmt.DistributedArray.to_dist(x.ravel(), mesh=mesh)
-        want = np.fft.fft2(x)
-        return _rel_err(np.asarray(y.asarray()).reshape(Op.dimsd_nd),
-                        want)
-    checks["pencil_fft2d"] = _check(fft, tol=1e-3)
 
     # --- explicit ring-halo stencil (ppermute + Pallas) end-to-end
     def ring():
@@ -196,6 +194,24 @@ def run_selfcheck() -> dict:
             pmt.DistributedArray.to_dist(np.zeros_like(xt), mesh=mesh))
         return _rel_err(out[0].asarray(), xt)
     checks["fused_cgls"] = _check(cgls, tol=1e-2)
+
+    # --- ragged pencil FFT2D (explicit all_to_all kernel) vs NumPy.
+    # LAST: see the ordering note above.
+    def fft():
+        dims = (100, 64)  # 100 % n_dev != 0 for n_dev in {3,6,8}: ragged
+        Op = pmt.MPIFFT2D(dims=dims, dtype=np.complex64)
+        x = (rng.standard_normal(dims) + 1j * rng.standard_normal(dims)
+             ).astype(np.complex64)
+        y = Op @ pmt.DistributedArray.to_dist(x.ravel(), mesh=mesh)
+        want = np.fft.fft2(x)
+        return _rel_err(np.asarray(y.asarray()).reshape(Op.dimsd_nd),
+                        want)
+    checks["pencil_fft2d"] = _check(fft, tol=1e-3)
+
+    # wedged-process marker: a failing canary means the fft failure
+    # poisoned the backend, not that plain compute is broken
+    checks["post_fft_canary"] = _check(lambda: abs(float(
+        (jnp.ones((8, 8)) @ jnp.ones((8, 8))).sum()) - 512.0))
 
     return {"kind": "tpu_selfcheck", "platform": platform,
             "n_devices": n_dev, "ts": time.time(),

@@ -6,6 +6,13 @@ collectives become XLA ``psum``/``all_gather``/``all_to_all``/``ppermute``
 over ICI/DCN; solver loops run on device as ``lax.while_loop``s.
 """
 
+from .utils.deps import apply_environment as _apply_environment
+
+# Honour the env seams (platform override, x64, matmul precision — the
+# last pins true-f32 GEMMs on TPU, see utils/deps.py) before anything
+# touches a jax backend.
+_apply_environment()
+
 from .parallel.partition import Partition, local_split
 from .parallel.mesh import (
     make_mesh, make_mesh_2d, make_mesh_hybrid, initialize_multihost,

@@ -1,0 +1,233 @@
+"""Local FFT engine seam: XLA's native FFT or a matmul (MXU) DFT.
+
+Every local (per-shard) transform in the distributed FFT family
+(``ops/fft.py``, consumed by ``MPIFFT2D``/``MPIFFTND``/``MPIMDC``) goes
+through the four functions here — ``fft``/``ifft``/``rfft``/``irfft``
+with ``jnp.fft`` signatures — instead of calling ``jnp.fft`` directly.
+
+Why: XLA lowers ``jnp.fft`` to an ``fft`` custom-call that not every
+TPU runtime implements — the experimental remote-tunnel backend used
+for this project's hardware benches returns ``UNIMPLEMENTED`` at run
+time (observed round 3; worse, the failure wedges the process so every
+subsequent dispatch also fails). A DFT expressed as matrix
+multiplication needs nothing beyond GEMM — the one thing a TPU always
+has — and for the batched many-small-FFT shapes of MDC-style operators
+it rides the MXU rather than a scalar FFT pipeline.
+
+Algorithm (``_MODE = matmul``): mixed-radix four-step Cooley–Tukey.
+``n`` is split as ``n1·n2`` with ``n1`` the largest divisor ≤
+``_BASE``; blocks of size ≤ ``_BASE`` are one GEMM against a cached
+DFT matrix; twiddle multiply between stages; recursion handles the
+co-factor. Sizes with a prime factor > ``_BASE`` use Bluestein's
+chirp-z: the length-``n`` DFT becomes a circular convolution of
+power-of-two size ``m ≥ 2n-1``, which the same mixed-radix engine
+evaluates (powers of two always factor). Inverse transforms run the
+conjugate recursion unscaled, with the single ``1/n`` applied at the
+top — matching ``jnp.fft.ifft`` semantics. Real transforms reuse the
+complex engine (a fallback favouring correctness; the reference's FFTW
+engine is replaced wholesale per SURVEY §2.6).
+
+Mode selection (``PYLOPS_MPI_TPU_FFT_MODE``):
+
+- ``auto`` (default): ``matmul`` on TPU backends, ``xla`` elsewhere.
+  Probing the custom-call at runtime is NOT possible — an
+  ``UNIMPLEMENTED`` poisons the probing process — so auto prefers the
+  path that works everywhere on TPU. Accuracy is f32-GEMM grade
+  (~1e-5 relative at n=4096 under the package's pinned ``highest``
+  matmul precision).
+- ``xla``: always ``jnp.fft`` (real TPU pods with a native FFT).
+- ``matmul``: force the GEMM engine (also useful on CPU for tests).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "fft_mode", "use_matmul_fft"]
+
+_BASE = 128  # direct-GEMM DFT at or below this length
+
+
+def fft_mode() -> str:
+    m = os.environ.get("PYLOPS_MPI_TPU_FFT_MODE", "auto").lower()
+    if m not in ("auto", "xla", "matmul"):
+        raise ValueError(f"PYLOPS_MPI_TPU_FFT_MODE={m!r}: expected "
+                         "auto|xla|matmul")
+    return m
+
+
+def use_matmul_fft() -> bool:
+    m = fft_mode()
+    if m == "auto":
+        return jax.default_backend() == "tpu"
+    return m == "matmul"
+
+
+# --------------------------------------------------------------- helpers
+
+@lru_cache(maxsize=128)
+def _dft_mat_np(n: int, sign: float, dtype: str) -> np.ndarray:
+    k = np.arange(n)
+    return np.exp(sign * 2j * np.pi * np.outer(k, k) / n).astype(dtype)
+
+
+@lru_cache(maxsize=128)
+def _twiddle_np(n1: int, n2: int, sign: float, dtype: str) -> np.ndarray:
+    # T[k1, j2] = ω_n^{±k1·j2},  n = n1·n2
+    n = n1 * n2
+    return np.exp(sign * 2j * np.pi
+                  * np.outer(np.arange(n1), np.arange(n2)) / n).astype(dtype)
+
+
+def _best_split(n: int) -> int:
+    """Largest divisor of ``n`` that is ≤ ``_BASE`` (1 if prime)."""
+    best = 1
+    d = 2
+    m = n
+    # factorize, then greedily pack factors under _BASE
+    factors = []
+    while d * d <= m:
+        while m % d == 0:
+            factors.append(d)
+            m //= d
+        d += 1
+    if m > 1:
+        factors.append(m)
+    for f in sorted(factors, reverse=True):
+        if best * f <= _BASE:
+            best *= f
+    return best
+
+
+def _complex_dtype(x):
+    return jnp.complex64 if x.dtype in (jnp.complex64, jnp.float32,
+                                        jnp.bfloat16, jnp.float16) \
+        else jnp.complex128
+
+
+def _fft_last(x: jax.Array, sign: float) -> jax.Array:
+    """Unscaled DFT along the last axis (sign=-1 forward, +1 inverse)."""
+    n = x.shape[-1]
+    dt = str(np.dtype(x.dtype))
+    if n <= _BASE:
+        F = jnp.asarray(_dft_mat_np(n, sign, dt))
+        return x @ F  # F symmetric: x @ F == x @ F.T
+    n1 = _best_split(n)
+    if n1 == 1:  # prime beyond the GEMM base: Bluestein chirp-z
+        return _bluestein_last(x, sign)
+    n2 = n // n1
+    a = x.reshape(x.shape[:-1] + (n1, n2))
+    # DFT_{n1} over j1 (axis -2): contract with the n1×n1 DFT matrix
+    F1 = jnp.asarray(_dft_mat_np(n1, sign, dt))
+    b = jnp.einsum("...jk,jl->...lk", a, F1)
+    b = b * jnp.asarray(_twiddle_np(n1, n2, sign, dt))
+    c = _fft_last(b, sign)                       # DFT_{n2} over j2
+    # X[k1 + n1·k2] = c[..., k1, k2] → transpose → flatten
+    return jnp.swapaxes(c, -1, -2).reshape(x.shape[:-1] + (n,))
+
+
+@lru_cache(maxsize=64)
+def _bluestein_consts(n: int, sign: float, dtype: str):
+    m = 1
+    while m < 2 * n - 1:
+        m *= 2
+    # chirp phases modulo 2n (j² mod 2n) keep full precision at large j
+    j = np.arange(n, dtype=np.int64)
+    ph = (j * j) % (2 * n)
+    chirp = np.exp(sign * 1j * np.pi * ph / n).astype(dtype)
+    h = np.zeros(m, dtype)
+    h[:n] = np.conj(chirp)
+    h[m - n + 1:] = np.conj(chirp[1:][::-1])
+    return m, chirp, h
+
+
+def _bluestein_last(x: jax.Array, sign: float) -> jax.Array:
+    n = x.shape[-1]
+    m, chirp_np, h_np = _bluestein_consts(n, sign, str(np.dtype(x.dtype)))
+    chirp = jnp.asarray(chirp_np)
+    xp = jnp.zeros(x.shape[:-1] + (m,), x.dtype)
+    xp = xp.at[..., :n].set(x * chirp)
+    # circular convolution with the chirp kernel via the matmul engine
+    # (m is a power of two → pure mixed-radix recursion, no re-entry)
+    Xf = _fft_last(xp, -1.0)
+    Hf = _fft_last(jnp.asarray(h_np), -1.0)
+    y = _fft_last(Xf * Hf, +1.0) / m
+    return y[..., :n] * chirp
+
+
+def _matmul_fft_1d(x: jax.Array, n, axis: int, sign: float,
+                   norm=None) -> jax.Array:
+    cdt = _complex_dtype(x)
+    x = x.astype(cdt)
+    src_n = x.shape[axis]
+    if n is not None and n != src_n:  # jnp.fft pad/truncate semantics
+        if n < src_n:
+            x = jax.lax.slice_in_dim(x, 0, n, axis=axis)
+        else:
+            pad = [(0, 0)] * x.ndim
+            pad[axis] = (0, n - src_n)
+            x = jnp.pad(x, pad)
+    x = jnp.moveaxis(x, axis, -1)
+    y = _fft_last(x, sign)
+    nn = y.shape[-1]
+    if norm == "ortho":
+        y = y / np.sqrt(nn)
+    elif norm == "forward":
+        if sign < 0:  # forward norm: fft carries the 1/n, ifft nothing
+            y = y / nn
+    elif norm in (None, "backward"):
+        if sign > 0:  # backward norm: ifft carries the 1/n
+            y = y / nn
+    else:
+        raise ValueError(f"unsupported norm {norm!r}: expected None, "
+                         "'backward', 'ortho' or 'forward'")
+    return jnp.moveaxis(y, -1, axis)
+
+
+# ------------------------------------------------------------- public API
+
+def fft(x, n=None, axis: int = -1, norm=None):
+    if not use_matmul_fft():
+        return jnp.fft.fft(x, n=n, axis=axis, norm=norm)
+    return _matmul_fft_1d(x, n, axis, -1.0, norm)
+
+
+def ifft(x, n=None, axis: int = -1, norm=None):
+    if not use_matmul_fft():
+        return jnp.fft.ifft(x, n=n, axis=axis, norm=norm)
+    return _matmul_fft_1d(x, n, axis, +1.0, norm)
+
+
+def rfft(x, n=None, axis: int = -1, norm=None):
+    if not use_matmul_fft():
+        return jnp.fft.rfft(x, n=n, axis=axis, norm=norm)
+    nn = x.shape[axis] if n is None else n
+    y = _matmul_fft_1d(x, nn, axis, -1.0, norm)
+    return jax.lax.slice_in_dim(y, 0, nn // 2 + 1, axis=axis)
+
+
+def irfft(x, n=None, axis: int = -1, norm=None):
+    if not use_matmul_fft():
+        return jnp.fft.irfft(x, n=n, axis=axis, norm=norm)
+    nh = x.shape[axis]
+    nn = 2 * (nh - 1) if n is None else n
+    keep = nn // 2 + 1
+    # pad/truncate the half-spectrum exactly like jnp.fft.irfft
+    if keep < nh:
+        x = jax.lax.slice_in_dim(x, 0, keep, axis=axis)
+    elif keep > nh:
+        pad = [(0, 0)] * x.ndim
+        pad[axis] = (0, keep - nh)
+        x = jnp.pad(x, pad)
+    # rebuild the Hermitian-symmetric full spectrum
+    mid = jax.lax.slice_in_dim(x, 1, keep - 1 if nn % 2 == 0 else keep,
+                               axis=axis)
+    tail = jnp.flip(jnp.conj(mid), axis=axis)
+    full = jnp.concatenate([x, tail], axis=axis)
+    y = _matmul_fft_1d(full, nn, axis, +1.0, norm)
+    return jnp.real(y)

@@ -9,12 +9,15 @@ pylops conventions: unnormalized forward, adjoint = N·ifft (norm
 non-Nyquist bins for ``real=True`` (ref ``_scale_real_fft:278-309``),
 and per-axis ifftshift-before / fftshift-after.
 
-TPU-native pencil: FFT the non-sharded axes locally with ``jnp.fft``,
-reshard (``all_to_all``, emitted by XLA for the sharding-constraint
-change) so the originally-sharded axis becomes local, FFT it, and ravel
-back to the flat axis-0-sharded vector — exactly PFFT's two-pencil
-dance (ref ``_pfft_in_axis``/``_pfft_out_axis``, ``FFTND.py:199-211``)
-with the compiler scheduling the transposes.
+TPU-native pencil: FFT the non-sharded axes locally, reshard
+(``all_to_all``, emitted by XLA for the sharding-constraint change) so
+the originally-sharded axis becomes local, FFT it, and ravel back to
+the flat axis-0-sharded vector — exactly PFFT's two-pencil dance (ref
+``_pfft_in_axis``/``_pfft_out_axis``, ``FFTND.py:199-211``) with the
+compiler scheduling the transposes. Local transforms go through
+``ops/dft.py`` — XLA's native FFT or the matmul (MXU) DFT engine for
+TPU runtimes without an FFT custom-call (fftshift/ifftshift are plain
+rolls and stay on ``jnp.fft``).
 """
 
 from __future__ import annotations
@@ -26,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import dft
 from ..distributedarray import DistributedArray, Partition
 from ..linearoperator import MPILinearOperator
 from ..parallel.mesh import axis_sharding
@@ -339,9 +343,9 @@ class _MPIBaseFFTND(MPILinearOperator):
             for ax in stage1:
                 nfft = self.nffts[axes.index(ax)]
                 if self.real and ax == axes[-1]:
-                    b = jnp.fft.rfft(b, n=nfft, axis=ax)
+                    b = dft.rfft(b, n=nfft, axis=ax)
                 else:
-                    b = jnp.fft.fft(b, n=nfft, axis=ax)
+                    b = dft.fft(b, n=nfft, axis=ax)
             if self.real:
                 b = self._scale_real(b, inverse=False)
             if 0 in axes:
@@ -349,7 +353,7 @@ class _MPIBaseFFTND(MPILinearOperator):
                 b = jnp.take(b, unpad_m, axis=0)       # exact dims[0]
                 if 0 in shift_before:
                     b = jnp.fft.ifftshift(b, axes=(0,))
-                b = jnp.fft.fft(b, n=nfft0, axis=0)    # exact dimsd[0]
+                b = dft.fft(b, n=nfft0, axis=0)    # exact dimsd[0]
                 if 0 in shift_after:
                     b = jnp.fft.fftshift(b, axes=(0,))
                 b = jnp.take(b, pad_d_src, axis=0)     # per-shard padded
@@ -409,7 +413,7 @@ class _MPIBaseFFTND(MPILinearOperator):
                 b = jnp.take(b, unpad_d, axis=0)       # exact dimsd[0]
                 if 0 in shift_after:
                     b = jnp.fft.ifftshift(b, axes=(0,))
-                b = jnp.fft.ifft(b, n=nfft0, axis=0)
+                b = dft.ifft(b, n=nfft0, axis=0)
                 b = b[:dims[0]]
                 if 0 in shift_before:
                     b = jnp.fft.fftshift(b, axes=(0,))
@@ -423,11 +427,11 @@ class _MPIBaseFFTND(MPILinearOperator):
                 sl[out_ax] = slice(0, dimsd[out_ax])   # crop tail pad
                 b = b[tuple(sl)]
             for ax in [a for a in axes[:-1] if a != 0][::-1]:
-                b = jnp.fft.ifft(b, n=self.nffts[axes.index(ax)], axis=ax)
+                b = dft.ifft(b, n=self.nffts[axes.index(ax)], axis=ax)
             if self.real:
-                b = jnp.fft.irfft(b, n=self.nffts[-1], axis=axes[-1])
+                b = dft.irfft(b, n=self.nffts[-1], axis=axes[-1])
             else:
-                b = jnp.fft.ifft(b, n=self.nffts[-1], axis=axes[-1])
+                b = dft.ifft(b, n=self.nffts[-1], axis=axes[-1])
             # crop local axes to model dims (nfft may exceed dims);
             # axis 0 was cropped while assembled in the transpose stage
             b = b[(slice(None),) + tuple(slice(0, d) for d in dims[1:])]
@@ -475,17 +479,17 @@ class _MPIBaseFFTND(MPILinearOperator):
         for ax in stage1:
             nfft = self.nffts[axes.index(ax)]
             if self.real and ax == axes[-1]:
-                g = jnp.fft.rfft(g, n=nfft, axis=ax)
+                g = dft.rfft(g, n=nfft, axis=ax)
             else:
-                g = jnp.fft.fft(g, n=nfft, axis=ax)
+                g = dft.fft(g, n=nfft, axis=ax)
         if in_ax in axes:
             if g.ndim > 1:  # pencil transpose; in_ax padding cropped
                 g, pad = self._reshard(g, self._out_axis, in_ax, pad)
             nfft = self.nffts[axes.index(in_ax)]
             if self.real and in_ax == axes[-1]:
-                g = jnp.fft.rfft(g, n=nfft, axis=in_ax)
+                g = dft.rfft(g, n=nfft, axis=in_ax)
             else:
-                g = jnp.fft.fft(g, n=nfft, axis=in_ax)
+                g = dft.fft(g, n=nfft, axis=in_ax)
             if g.ndim > 1:
                 g = self._crop(g, self._out_axis, pad)
         elif g.ndim > 1:
@@ -517,26 +521,26 @@ class _MPIBaseFFTND(MPILinearOperator):
         if g.ndim == 1:
             g = self._constrain_replicated(g)
             if self.real:
-                g = jnp.fft.irfft(g, n=self.nffts[-1], axis=0)
+                g = dft.irfft(g, n=self.nffts[-1], axis=0)
             else:
-                g = jnp.fft.ifft(g, n=self.nffts[-1], axis=0)
+                g = dft.ifft(g, n=self.nffts[-1], axis=0)
         else:
             pad = 0
             if in_ax in axes:
                 g, pad = self._reshard(g, self._out_axis)
                 nfft = self.nffts[axes.index(in_ax)]
                 if self.real and in_ax == axes[-1]:
-                    g = jnp.fft.irfft(g, n=nfft, axis=in_ax)
+                    g = dft.irfft(g, n=nfft, axis=in_ax)
                 else:
-                    g = jnp.fft.ifft(g, n=nfft, axis=in_ax)
+                    g = dft.ifft(g, n=nfft, axis=in_ax)
             g, pad = self._reshard(g, in_ax, self._out_axis, pad)
             for ax in [a for a in axes[:-1] if a != in_ax][::-1]:
-                g = jnp.fft.ifft(g, n=self.nffts[axes.index(ax)], axis=ax)
+                g = dft.ifft(g, n=self.nffts[axes.index(ax)], axis=ax)
             if axes[-1] != in_ax:
                 if self.real:
-                    g = jnp.fft.irfft(g, n=self.nffts[-1], axis=axes[-1])
+                    g = dft.irfft(g, n=self.nffts[-1], axis=axes[-1])
                 else:
-                    g = jnp.fft.ifft(g, n=self.nffts[-1], axis=axes[-1])
+                    g = dft.ifft(g, n=self.nffts[-1], axis=axes[-1])
             g = self._crop(g, in_ax, pad)
         # crop to model dims (nfft may exceed dims)
         idx = tuple(slice(0, d) for d in self.dims_nd)

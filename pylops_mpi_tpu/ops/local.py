@@ -17,6 +17,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import dft
+
 __all__ = [
     "LocalOperator", "MatrixMult", "Identity", "Diagonal", "Zero",
     "Transpose", "FirstDerivative", "SecondDerivative", "Laplacian",
@@ -596,10 +598,10 @@ class FFT(LocalOperator):
         if self.ifftshift_before:
             v = jnp.fft.ifftshift(v, axes=self.axis)
         if self.real:
-            y = jnp.fft.rfft(v.real, n=self.nfft, axis=self.axis, norm="ortho")
+            y = dft.rfft(v.real, n=self.nfft, axis=self.axis, norm="ortho")
             y = self._scale_pos(y, np.sqrt(2.0))
         else:
-            y = jnp.fft.fft(v, n=self.nfft, axis=self.axis, norm="ortho")
+            y = dft.fft(v, n=self.nfft, axis=self.axis, norm="ortho")
         return y.ravel()
 
     def _rmatvec(self, x):
@@ -608,9 +610,9 @@ class FFT(LocalOperator):
             # adjoint of (√2-scaled) rfft: halve the doubled bins and let
             # irfft's Hermitian extension supply the other half
             v = self._scale_pos(v, 1.0 / np.sqrt(2.0))
-            y = jnp.fft.irfft(v, n=self.nfft, axis=self.axis, norm="ortho")
+            y = dft.irfft(v, n=self.nfft, axis=self.axis, norm="ortho")
         else:
-            y = jnp.fft.ifft(v, n=self.nfft, axis=self.axis, norm="ortho")
+            y = dft.ifft(v, n=self.nfft, axis=self.axis, norm="ortho")
         idx = [slice(None)] * len(self.dims_nd)
         idx[self.axis] = slice(0, self.dims_nd[self.axis])
         y = y[tuple(idx)]

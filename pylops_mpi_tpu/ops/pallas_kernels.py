@@ -160,33 +160,40 @@ def stencil_taps(slab: jax.Array, taps, w: int,
 _VMEM_TILE_BYTES = 4 << 20  # A-tile budget (double-buffered by pipeline)
 
 
-def _pick_tile(m: int, n: int, itemsize: int) -> int:
-    for tm in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1):
+def _pick_tile(m: int, n: int, itemsize: int):
+    """Row-tile honouring both the VMEM budget and Mosaic's sublane
+    rule: every blocked dim must be 8-divisible (sublanes) or equal to
+    the full array dim — the round-3 hardware selfcheck showed tiles of
+    1/2/4 rows that pass in interpret mode are rejected by the TPU
+    lowering. ``None`` when no legal tile fits (caller falls back to the
+    generic two-sweep path)."""
+    for tm in (512, 256, 128, 64, 32, 16, 8):
         if m % tm == 0 and tm * n * itemsize <= _VMEM_TILE_BYTES:
             return tm
-    return 1
+    if m * n * itemsize <= _VMEM_TILE_BYTES:
+        return m  # whole-dim block: always legal
+    return None
 
 
 def normal_matvec_supported(A: jax.Array) -> bool:
     """Pallas path requires real floating blocks (complex dots fall back
-    to the generic two-sweep path) narrow enough that a single row tile
-    fits the VMEM budget — otherwise even tm=1 would fail at Mosaic
-    compile time and the generic two-sweep path must be used."""
+    to the generic two-sweep path) for which a Mosaic-legal row tile
+    fits the VMEM budget — otherwise the generic path must be used."""
     if not (_HAS_PALLAS and pallas_available() and A.ndim == 3
             and not jnp.iscomplexobj(A)):
         return False
-    n = A.shape[2]
-    return n * max(A.dtype.itemsize, 4) <= _VMEM_TILE_BYTES
+    m, n = A.shape[1], A.shape[2]
+    return _pick_tile(m, n, max(A.dtype.itemsize, 4)) is not None
 
 
 def _normal_kernel(a_ref, x_ref, u_ref, q_ref):
     i = pl.program_id(1)
     acc = jnp.promote_types(a_ref.dtype, jnp.float32)  # f32 acc for bf16/f32
     a = a_ref[0].astype(acc)                        # (TM, n)
-    x = x_ref[...].astype(acc)                      # (1, n)
+    x = x_ref[0].astype(acc)                        # (1, n)
     t = jax.lax.dot_general(a, x, (((1,), (1,)), ((), ())),
                             preferred_element_type=acc)  # (TM, 1)
-    q_ref[...] = t.T.astype(q_ref.dtype)
+    q_ref[...] = t[None].astype(q_ref.dtype)        # block (1, TM, 1)
     u = jax.lax.dot_general(t, a, (((0,), (0,)), ((), ())),
                             preferred_element_type=acc)  # (1, n)
 
@@ -194,7 +201,7 @@ def _normal_kernel(a_ref, x_ref, u_ref, q_ref):
     def _():
         u_ref[...] = jnp.zeros_like(u_ref)
 
-    u_ref[...] += u.astype(u_ref.dtype)
+    u_ref[...] += u[None].astype(u_ref.dtype)
 
 
 def batched_normal_matvec(A: jax.Array, X: jax.Array):
@@ -202,20 +209,26 @@ def batched_normal_matvec(A: jax.Array, X: jax.Array):
 
     A: ``(nblk, m, n)`` real; X: ``(nblk, n)``. Returns
     ``u (nblk, n)``, ``q (nblk, m)``. Call per shard (inside shard_map);
-    on CPU runs in interpret mode.
+    on CPU runs in interpret mode. The x/u/q operands are staged as
+    trivially-blocked 3-D views — a 2-D ``(1, n)`` block over an
+    ``(nblk, n)`` array has a sublane dim of 1 that is neither
+    8-divisible nor equal to ``nblk``, which Mosaic rejects.
     """
     nblk, m, n = A.shape
     tm = _pick_tile(m, n, max(A.dtype.itemsize, 4))  # bound the f32 copy
+    if tm is None:
+        raise ValueError(f"no Mosaic-legal row tile for blocks of {m}x{n}; "
+                         "gate on normal_matvec_supported()")
     out_dtype = X.dtype
     u, q = pl.pallas_call(
         _normal_kernel,
         grid=(nblk, m // tm),
         in_specs=[pl.BlockSpec((1, tm, n), lambda b, i: (b, i, 0)),
-                  pl.BlockSpec((1, n), lambda b, i: (b, 0))],
-        out_specs=[pl.BlockSpec((1, n), lambda b, i: (b, 0)),
-                   pl.BlockSpec((1, tm), lambda b, i: (b, i))],
-        out_shape=[jax.ShapeDtypeStruct((nblk, n), out_dtype),
-                   jax.ShapeDtypeStruct((nblk, m), out_dtype)],
+                  pl.BlockSpec((1, 1, n), lambda b, i: (b, 0, 0))],
+        out_specs=[pl.BlockSpec((1, 1, n), lambda b, i: (b, 0, 0)),
+                   pl.BlockSpec((1, tm, 1), lambda b, i: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((nblk, 1, n), out_dtype),
+                   jax.ShapeDtypeStruct((nblk, m, 1), out_dtype)],
         interpret=_interpret(),
-    )(A, X)
-    return u, q
+    )(A, X[:, None, :])
+    return u[:, 0, :], q[:, :, 0]

@@ -13,6 +13,15 @@ backend — XLA collectives — so the seam carries different switches:
   (ref ``utils/benchmark.py:25``; both names honoured).
 - ``TEST_CUPY_PYLOPS`` has no analog (no CuPy engine); kept as a no-op
   recognised name so reference test-harness scripts don't break.
+- ``PYLOPS_MPI_TPU_MATMUL_PRECISION``: default ``highest`` — on TPU the
+  stock matmul precision decomposes f32 operands into bf16 MXU passes
+  (~1e-3 relative error, measured on hardware by the round-3
+  selfcheck's SUMMA check), which breaks numerics parity with the
+  reference's true-f32 GEMMs. Pinning ``jax_default_matmul_precision``
+  makes ``float32`` operators mean float32; the fast path stays
+  available explicitly through ``compute_dtype=bfloat16`` (bf16 inputs
+  are unaffected by the precision flag). Set to ``default`` to restore
+  JAX's backend default.
 """
 
 from __future__ import annotations
@@ -41,6 +50,13 @@ def x64_enabled() -> bool:
     return os.environ.get("PYLOPS_MPI_TPU_X64", "0") == "1"
 
 
+def matmul_precision():
+    """``jax_default_matmul_precision`` to pin at import (see module
+    docstring); ``default``/empty leaves JAX's backend default."""
+    p = os.environ.get("PYLOPS_MPI_TPU_MATMUL_PRECISION", "highest")
+    return None if p in ("", "default") else p
+
+
 _applied = False
 
 
@@ -56,4 +72,7 @@ def apply_environment() -> None:
         jax.config.update("jax_platforms", plat)
     if x64_enabled():
         jax.config.update("jax_enable_x64", True)
+    prec = matmul_precision()
+    if prec is not None:
+        jax.config.update("jax_default_matmul_precision", prec)
     _applied = True

@@ -1,0 +1,125 @@
+"""Tests for the local FFT engine seam (``ops/dft.py``).
+
+The matmul (MXU) DFT engine exists because some TPU runtimes ship no
+FFT custom-call (``jnp.fft`` dies with runtime UNIMPLEMENTED — observed
+on hardware in round 3, see ``benchmarks/tpu_selfcheck.py``). The
+engine must match ``numpy.fft`` bit-for-tolerance across mixed-radix,
+prime (Bluestein), power-of-two, padded/truncated, real and ortho-norm
+cases, in both precisions, so that forcing
+``PYLOPS_MPI_TPU_FFT_MODE=matmul`` is purely an execution-path choice.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pylops_mpi_tpu.ops import dft
+
+
+def _rel(got, want):
+    got = np.asarray(got).astype(np.complex128)
+    want = np.asarray(want).astype(np.complex128)
+    return float(np.linalg.norm((got - want).ravel())
+                 / max(np.linalg.norm(want.ravel()), 1e-300))
+
+
+def _force_matmul(monkeypatch):
+    monkeypatch.setenv("PYLOPS_MPI_TPU_FFT_MODE", "matmul")
+
+
+# sizes exercising each code path: GEMM base, mixed-radix composite,
+# power of two, prime > base (Bluestein), and a ragged odd composite
+SIZES = [8, 100, 128, 192, 256, 263, 1000, 1024]
+
+
+@pytest.mark.parametrize("n", SIZES)
+def test_fft_matches_numpy(monkeypatch, n):
+    _force_matmul(monkeypatch)
+    rng = np.random.default_rng(3)
+    x = (rng.standard_normal((3, n))
+         + 1j * rng.standard_normal((3, n))).astype(np.complex64)
+    assert _rel(dft.fft(jnp.asarray(x)), np.fft.fft(x)) < 2e-6
+    assert _rel(dft.ifft(jnp.asarray(x)), np.fft.ifft(x)) < 2e-6
+
+
+@pytest.mark.parametrize("n,nfft", [(100, 160), (100, 60), (128, 128)])
+def test_fft_pad_truncate(monkeypatch, n, nfft):
+    _force_matmul(monkeypatch)
+    rng = np.random.default_rng(4)
+    x = (rng.standard_normal((2, n))
+         + 1j * rng.standard_normal((2, n))).astype(np.complex64)
+    assert _rel(dft.fft(jnp.asarray(x), n=nfft),
+                np.fft.fft(x, n=nfft)) < 2e-6
+
+
+@pytest.mark.parametrize("axis", [0, 1, -1])
+def test_fft_axis(monkeypatch, axis):
+    _force_matmul(monkeypatch)
+    rng = np.random.default_rng(5)
+    x = (rng.standard_normal((24, 36))
+         + 1j * rng.standard_normal((24, 36))).astype(np.complex64)
+    assert _rel(dft.fft(jnp.asarray(x), axis=axis),
+                np.fft.fft(x, axis=axis)) < 2e-6
+
+
+@pytest.mark.parametrize("n,nfft", [(100, None), (100, 128), (101, 101),
+                                    (64, 48)])
+def test_rfft_irfft(monkeypatch, n, nfft):
+    _force_matmul(monkeypatch)
+    rng = np.random.default_rng(6)
+    x = rng.standard_normal((3, n)).astype(np.float32)
+    assert _rel(dft.rfft(jnp.asarray(x), n=nfft),
+                np.fft.rfft(x, n=nfft)) < 2e-6
+    nh = (nfft or n) // 2 + 1
+    c = (rng.standard_normal((3, nh))
+         + 1j * rng.standard_normal((3, nh))).astype(np.complex64)
+    assert _rel(dft.irfft(jnp.asarray(c), n=nfft),
+                np.fft.irfft(c, n=nfft)) < 2e-6
+
+
+def test_ortho_norm(monkeypatch):
+    _force_matmul(monkeypatch)
+    rng = np.random.default_rng(7)
+    x = (rng.standard_normal((2, 96))
+         + 1j * rng.standard_normal((2, 96))).astype(np.complex64)
+    assert _rel(dft.fft(jnp.asarray(x), norm="ortho"),
+                np.fft.fft(x, norm="ortho")) < 2e-6
+    assert _rel(dft.ifft(jnp.asarray(x), norm="ortho"),
+                np.fft.ifft(x, norm="ortho")) < 2e-6
+    xr = rng.standard_normal((2, 96)).astype(np.float32)
+    assert _rel(dft.rfft(jnp.asarray(xr), norm="ortho"),
+                np.fft.rfft(xr, norm="ortho")) < 2e-6
+
+
+def test_roundtrip(monkeypatch):
+    _force_matmul(monkeypatch)
+    rng = np.random.default_rng(8)
+    x = (rng.standard_normal((4, 263))
+         + 1j * rng.standard_normal((4, 263))).astype(np.complex64)
+    assert _rel(dft.ifft(dft.fft(jnp.asarray(x))), x) < 2e-6
+
+
+def test_mode_validation(monkeypatch):
+    monkeypatch.setenv("PYLOPS_MPI_TPU_FFT_MODE", "nonsense")
+    with pytest.raises(ValueError, match="PYLOPS_MPI_TPU_FFT_MODE"):
+        dft.fft_mode()
+
+
+def test_auto_mode_cpu_uses_xla(monkeypatch):
+    monkeypatch.delenv("PYLOPS_MPI_TPU_FFT_MODE", raising=False)
+    # tests run on the forced-CPU backend: auto must pick xla there
+    assert dft.use_matmul_fft() is False
+
+
+def test_x64_precision(monkeypatch):
+    _force_matmul(monkeypatch)
+    from pylops_mpi_tpu.utils import deps
+    if not deps.x64_enabled():
+        import jax
+        if not jax.config.jax_enable_x64:
+            pytest.skip("x64 disabled in this session")
+    rng = np.random.default_rng(9)
+    x = (rng.standard_normal((2, 192))
+         + 1j * rng.standard_normal((2, 192))).astype(np.complex128)
+    assert _rel(dft.fft(jnp.asarray(x)), np.fft.fft(x)) < 1e-12
