@@ -74,6 +74,8 @@ PAGES = {
          ["first_derivative_centered", "second_derivative", "stencil_taps",
           "batched_normal_matvec", "normal_matvec_supported",
           "pallas_available"]),
+        ("Local FFT engine", "pylops_mpi_tpu.ops.dft",
+         ["fft", "ifft", "rfft", "irfft", "fft_mode", "use_matmul_fft"]),
     ],
     "utils": [
         ("Testing", "pylops_mpi_tpu.utils.dottest", ["dottest"]),
@@ -89,7 +91,7 @@ PAGES = {
         ("Decorators", "pylops_mpi_tpu.utils.decorators", ["reshaped"]),
         ("Feature flags", "pylops_mpi_tpu.utils.deps",
          ["platform_override", "explicit_stencil_enabled", "x64_enabled",
-          "apply_environment"]),
+          "matmul_precision", "apply_environment"]),
         ("Native host runtime", "pylops_mpi_tpu.native",
          ["available", "pack_padded", "unpack_padded", "read_binary",
           "write_binary", "write_binary_at", "local_split_native"]),
