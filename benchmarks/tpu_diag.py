@@ -25,7 +25,7 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
-LOG = open("tpu_diag_log.txt", "w")
+LOG = None  # opened in main(); import must stay side-effect free
 
 
 def step(name, fn):
@@ -48,6 +48,8 @@ def step(name, fn):
 
 
 def main():
+    global LOG
+    LOG = open(os.path.join(_ROOT, "tpu_diag_log.txt"), "w")
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -98,7 +100,8 @@ def main():
 
     def _shmap_allgather():
         f = shard_map(lambda x: lax.all_gather(x, ax0, tiled=True),
-                      mesh=mesh, in_specs=P(ax0), out_specs=P())
+                      mesh=mesh, in_specs=P(ax0), out_specs=P(),
+                      check_vma=False)
         return float(f(jnp.arange(8.0)).sum())
     step("shard_map_all_gather", _shmap_allgather)
 
@@ -214,6 +217,42 @@ def main():
         uw = np.einsum("bmn,bm->bn", A, qw)
         return float(np.abs(np.asarray(u) - uw).max() / np.abs(uw).max())
     step("normal_matvec_1024", _nm_fixed_flagship_shape)
+
+    def _normal_perf():
+        """Why was bf16 fused-normal SLOWER than f32 two-sweep in the
+        round-3 small flagship (772 vs 1339 iters/s)? Time one sweep of
+        each formulation at the same shape; returns µs per variant."""
+        import jax as _jax
+        n = 1024
+        A = jnp.asarray(rng.standard_normal((1, n, n)).astype(np.float32))
+        Ab = A.astype(jnp.bfloat16)
+        X = jnp.asarray(rng.standard_normal((1, n)).astype(np.float32))
+
+        def two_sweep(a, x):
+            q = jnp.einsum("bmn,bn->bm", a, x,
+                           preferred_element_type=jnp.float32)
+            return jnp.einsum("bmn,bm->bn", a, q.astype(x.dtype),
+                              preferred_element_type=jnp.float32)
+
+        out = {}
+        for name, fn, args in [
+                ("two_sweep_f32", _jax.jit(two_sweep), (A, X)),
+                ("two_sweep_bf16", _jax.jit(two_sweep), (Ab, X)),
+                ("pallas_normal_f32",
+                 _jax.jit(pk.batched_normal_matvec), (A, X)),
+                ("pallas_normal_bf16",
+                 _jax.jit(pk.batched_normal_matvec), (Ab, X))]:
+            r = fn(*args)
+            _jax.block_until_ready(r)
+            dt = float("inf")
+            for _ in range(20):
+                t0 = time.perf_counter()
+                r = fn(*args)
+                _jax.block_until_ready(r)
+                dt = min(dt, time.perf_counter() - t0)
+            out[name] = round(dt * 1e6, 1)
+        return out
+    step("normal_matvec_perf_us", _normal_perf)
 
     def _summa_prec():
         A = rng.standard_normal((192, 160)).astype(np.float32)

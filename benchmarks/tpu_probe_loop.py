@@ -167,16 +167,29 @@ def _stage_flagship(env, size: str):
         env, timeout=timeout, cwd=_ROOT)
 
 
+# the rev key must change when CODE changes, not when artifacts do:
+# keying on HEAD would invalidate banked 40-minute stages every time the
+# daemon's own log/cache files (or docs) get committed
+_CODE_PATHS = ["pylops_mpi_tpu", "benchmarks", "bench.py",
+               "__graft_entry__.py"]
+
+
 def _code_rev() -> str:
     import subprocess
     try:
-        h = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+        trees = []
+        for p in _CODE_PATHS:
+            r = subprocess.run(["git", "rev-parse", f"HEAD:{p}"],
+                               capture_output=True, text=True, cwd=_ROOT,
+                               timeout=10)
+            trees.append(r.stdout.strip()[:12] if r.returncode == 0
+                         else "none")
+        d = subprocess.run(["git", "status", "--porcelain", "--"]
+                           + _CODE_PATHS,
                            capture_output=True, text=True, cwd=_ROOT,
                            timeout=10).stdout.strip()
-        d = subprocess.run(["git", "status", "--porcelain"],
-                           capture_output=True, text=True, cwd=_ROOT,
-                           timeout=10).stdout.strip()
-        return h + ("+dirty" if d else "")
+        key = "-".join(t[:7] for t in trees)
+        return key + ("+dirty" if d else "")
     except Exception:
         return "unknown"
 

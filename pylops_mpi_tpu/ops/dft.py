@@ -143,20 +143,23 @@ def _bluestein_consts(n: int, sign: float, dtype: str):
     h = np.zeros(m, dtype)
     h[:n] = np.conj(chirp)
     h[m - n + 1:] = np.conj(chirp[1:][::-1])
-    return m, chirp, h
+    # the kernel spectrum is a compile-time constant: transform it on
+    # the host (f64, then cast) instead of tracing a second length-m
+    # matmul DFT into every prime-size transform
+    hf = np.fft.fft(h.astype(np.complex128)).astype(dtype)
+    return m, chirp, hf
 
 
 def _bluestein_last(x: jax.Array, sign: float) -> jax.Array:
     n = x.shape[-1]
-    m, chirp_np, h_np = _bluestein_consts(n, sign, str(np.dtype(x.dtype)))
+    m, chirp_np, hf_np = _bluestein_consts(n, sign, str(np.dtype(x.dtype)))
     chirp = jnp.asarray(chirp_np)
     xp = jnp.zeros(x.shape[:-1] + (m,), x.dtype)
     xp = xp.at[..., :n].set(x * chirp)
     # circular convolution with the chirp kernel via the matmul engine
     # (m is a power of two → pure mixed-radix recursion, no re-entry)
     Xf = _fft_last(xp, -1.0)
-    Hf = _fft_last(jnp.asarray(h_np), -1.0)
-    y = _fft_last(Xf * Hf, +1.0) / m
+    y = _fft_last(Xf * jnp.asarray(hf_np), +1.0) / m
     return y[..., :n] * chirp
 
 
