@@ -117,6 +117,47 @@ def _bench_fft(pmt, rng, n_dev, scale):
             "shape": f"{nf[0]}x{nf[1]}"}
 
 
+def _bench_dft_engine(pmt, rng, n_dev, scale):
+    """Local FFT engine seam (ops/dft.py): batched MDC-like 1-D
+    transforms, matmul (MXU GEMM) engine vs XLA's native FFT. On
+    runtimes without an FFT custom-call only the matmul number exists
+    (xla_gflops: null)."""
+    import os
+    import jax
+    import jax.numpy as jnp
+    from pylops_mpi_tpu.ops import dft
+
+    batch, n = 128 * scale, 1024  # 1024 = 8 × 128: pure GEMM radix path
+    x = (rng.standard_normal((batch, n))
+         + 1j * rng.standard_normal((batch, n))).astype(np.complex64)
+    xd = jnp.asarray(x)
+    flops = 5 * batch * n * np.log2(n)  # FFT-equivalent flop convention
+
+    prev = os.environ.get("PYLOPS_MPI_TPU_FFT_MODE")
+    out = {}
+    try:
+        for mode in ("matmul", "xla"):
+            os.environ["PYLOPS_MPI_TPU_FFT_MODE"] = mode
+            try:
+                fn = jax.jit(lambda v: dft.fft(v, axis=-1))
+                jax.block_until_ready(fn(xd))  # compile + dead-op probe
+                dt = _timeit(fn, xd, inner=10)
+                out[mode] = round(flops / dt / 1e9, 1)
+            except Exception:
+                # e.g. UNIMPLEMENTED fft custom-call; this config runs
+                # isolated on TPU so a wedge cannot poison the rest
+                out[mode] = None
+    finally:
+        if prev is None:
+            os.environ.pop("PYLOPS_MPI_TPU_FFT_MODE", None)
+        else:
+            os.environ["PYLOPS_MPI_TPU_FFT_MODE"] = prev
+    return {"bench": "dft_engine",
+            "value": out.get("matmul"), "unit": "GFLOP/s (matmul engine)",
+            "xla_gflops": out.get("xla"),
+            "shape": f"{batch}x{n}"}
+
+
 def _bench_fredholm(pmt, rng, n_dev, scale):
     import jax
     nsl, nx_, ny_, nz_ = 8 * n_dev * scale, 64, 64, 4
@@ -226,7 +267,11 @@ _BENCHES = [("first_derivative_halo", _bench_first_derivative),
             ("pencil_fft2d", _bench_fft),
             ("fredholm1_batched", _bench_fredholm),
             ("poststack_inversion", _bench_poststack),
-            ("cgls_multirhs", _bench_cgls_multirhs)]
+            ("cgls_multirhs", _bench_cgls_multirhs),
+            # LAST: its xla-mode probe can wedge an FFT-less runtime's
+            # process (benign when isolated; ordering protects the
+            # in-process fallback path)
+            ("dft_engine", _bench_dft_engine)]
 
 
 def run_components(quick: bool = False, only=None):

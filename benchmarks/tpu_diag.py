@@ -218,6 +218,38 @@ def main():
         return float(np.abs(np.asarray(u) - uw).max() / np.abs(uw).max())
     step("normal_matvec_1024", _nm_fixed_flagship_shape)
 
+    def _backend_floor():
+        """Separate the two candidate explanations for the slow small
+        flagship (1339 it/s f32 ≈ 750 µs/iter at a shape worth ~10 µs):
+        per-iteration while_loop overhead vs raw MXU/HBM throughput."""
+        import jax as _jax
+        # (a) trivial while_loop: 1000 iterations of scalar work
+        f = _jax.jit(lambda: lax.while_loop(
+            lambda c: c[0] < 1000,
+            lambda c: (c[0] + 1, c[1] * 1.000001 + 0.5),
+            (0, jnp.float32(1.0)))[1])
+        _jax.block_until_ready(f())
+        dt = float("inf")
+        for _ in range(10):
+            t0 = time.perf_counter()
+            _jax.block_until_ready(f())
+            dt = min(dt, time.perf_counter() - t0)
+        loop_ns_per_iter = dt / 1000 * 1e9
+        # (b) one fat GEMM: 2048^3 ≈ 17.2 GFLOP
+        n = 2048
+        A = jnp.ones((n, n), jnp.bfloat16)
+        g = _jax.jit(lambda a: a @ a)
+        _jax.block_until_ready(g(A))
+        dt = float("inf")
+        for _ in range(10):
+            t0 = time.perf_counter()
+            _jax.block_until_ready(g(A))
+            dt = min(dt, time.perf_counter() - t0)
+        gemm_tflops = 2 * n ** 3 / dt / 1e12
+        return {"while_loop_ns_per_iter": round(loop_ns_per_iter, 1),
+                "bf16_gemm_tflops": round(gemm_tflops, 2)}
+    step("backend_floor", _backend_floor)
+
     def _normal_perf():
         """Why was bf16 fused-normal SLOWER than f32 two-sweep in the
         round-3 small flagship (772 vs 1339 iters/s)? Time one sweep of

@@ -100,6 +100,27 @@ def test_roundtrip(monkeypatch):
     assert _rel(dft.ifft(dft.fft(jnp.asarray(x))), x) < 2e-6
 
 
+def test_every_small_n(monkeypatch):
+    """Exhaustive n=1..64: every factorization shape (1, primes, prime
+    powers, mixed composites) through the engine in one compile-free
+    sweep — factorization bugs hide in small sizes."""
+    _force_matmul(monkeypatch)
+    rng = np.random.default_rng(11)
+    for n in range(1, 65):
+        x = (rng.standard_normal((2, n))
+             + 1j * rng.standard_normal((2, n))).astype(np.complex64)
+        assert _rel(dft.fft(jnp.asarray(x)), np.fft.fft(x)) < 5e-6, n
+
+
+def test_large_prime_and_prime_power(monkeypatch):
+    _force_matmul(monkeypatch)
+    rng = np.random.default_rng(12)
+    for n in (131, 169, 243, 512):  # prime>128, 13², 3⁵, 2⁹
+        x = (rng.standard_normal((2, n))
+             + 1j * rng.standard_normal((2, n))).astype(np.complex64)
+        assert _rel(dft.fft(jnp.asarray(x)), np.fft.fft(x)) < 5e-6, n
+
+
 def test_mode_validation(monkeypatch):
     monkeypatch.setenv("PYLOPS_MPI_TPU_FFT_MODE", "nonsense")
     with pytest.raises(ValueError, match="PYLOPS_MPI_TPU_FFT_MODE"):
