@@ -190,6 +190,33 @@ def test_fftnd_odd_sizes_no_replication(rng):
         f"full-array gather in HLO: {sizes} vs n={n}"
 
 
+def test_fftnd_matmul_engine_no_replication(rng, monkeypatch):
+    """The matmul-DFT local engine (ops/dft.py, used on FFT-less TPU
+    runtimes) must keep the SAME pencil collective schedule — its GEMMs
+    are per-shard local math, so swapping engines may not introduce any
+    new gather of the global array."""
+    import re
+    import jax
+    monkeypatch.setenv("PYLOPS_MPI_TPU_FFT_MODE", "matmul")
+    dims = (17, 13, 9)
+    n = int(np.prod(dims))
+    Fop = MPIFFTND(dims, axes=(0, 1, 2), dtype=np.complex128)
+    dx = DistributedArray.to_dist(
+        rng.standard_normal(n) + 1j * rng.standard_normal(n),
+        local_shapes=Fop.model_local_shapes)
+    hlo = jax.jit(Fop._matvec).lower(dx).compile().as_text()
+    assert "all-to-all" in hlo, "pencil transposes must be all-to-all"
+    sizes = [int(np.prod([int(d) for d in m.split(",")]))
+             for m in re.findall(
+                 r"all-gather[^=]*= [a-z0-9]+\[([0-9,]+)\]", hlo)]
+    assert all(s < n // 2 for s in sizes), \
+        f"full-array gather in HLO: {sizes} vs n={n}"
+    # and it must agree with the xla-engine result on the same input
+    got = np.asarray(Fop.matvec(dx).asarray()).reshape(dims)
+    want = np.fft.fftn(np.asarray(dx.asarray()).reshape(dims))
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10)
+
+
 def test_fftnd_axes_ending_in_zero(rng):
     """axes[-1]==0 forces the in_axis=1 pencil layout (generic path,
     ref FFTND.py:188-197)."""
