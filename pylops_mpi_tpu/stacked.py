@@ -82,6 +82,12 @@ class StackedDistributedArray:
     def zeros_like(self):
         return self._apply(lambda d: d.zeros_like())
 
+    def empty_like(self):
+        """Same layouts, uninitialized-semantics (zeros here: XLA has no
+        cheaper alloc) — ref 0.6.0 ``StackedDistributedArray``
+        addition."""
+        return self._apply(lambda d: d.empty_like())
+
     def __neg__(self):
         return self._apply(lambda d: -d)
 

@@ -323,6 +323,9 @@ def test_stacked_array_scalar_ops(rng):
     np.testing.assert_allclose(s.conj().asarray(), full, rtol=1e-12)
     z = s.zeros_like()
     np.testing.assert_allclose(z.asarray(), 0.0)
+    e = s.empty_like()  # ref 0.6.0 addition: same layouts per entry
+    assert [d.global_shape for d in e.distarrays] == \
+        [d.global_shape for d in s.distarrays]
     c = s.copy()
     np.testing.assert_allclose(c.asarray(), full, rtol=1e-12)
 
