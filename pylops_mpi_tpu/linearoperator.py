@@ -152,6 +152,11 @@ class MPILinearOperator:
             raise ValueError("Scalar not allowed, use * instead")
         return self.__mul__(x)
 
+    def __rmatmul__(self, x):
+        if np.isscalar(x):
+            raise ValueError("Scalar not allowed, use * instead")
+        return self.__rmul__(x)
+
     def __pow__(self, p):
         return _PowerLinearOperator(self, p)
 

@@ -55,6 +55,10 @@ class _BaseSolver:
         if callback is not None:
             self.callback = callback
 
+    def memory_usage(self) -> None:
+        """No-op hook, reference Solver-ABC parity
+        (ref ``cls_basic.py:54-55``)."""
+
 
 class CG(_BaseSolver):
     """Conjugate gradient for square distributed operators
