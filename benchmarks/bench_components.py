@@ -212,6 +212,35 @@ def _bench_poststack(pmt, rng, n_dev, scale):
             "shape": f"{nxs}x{nt0},10it"}
 
 
+def _bench_mdc(pmt, rng, n_dev, scale):
+    """MDC apply (BASELINE config #5's composite chain: rFFT →
+    frequency-sharded Fredholm batched GEMM → irFFT). Forward+adjoint
+    sweep timed; flops ≈ the Fredholm core's complex batched matmuls
+    (8 real flop per complex MAC), FFT work excluded."""
+    import jax
+    nt, ns, nr, nv = 65, 24, 24, 2 * scale
+    nfmax = 16 * max(n_dev // 2, 1)
+    G = (rng.standard_normal((nfmax, ns, nr))
+         + 1j * rng.standard_normal((nfmax, ns, nr))
+         ).astype(np.complex64)
+    Op = pmt.MPIMDC(G, nt=nt, nv=nv, dt=0.004, dr=1.0, twosided=True)
+    x = pmt.DistributedArray.to_dist(
+        rng.standard_normal(Op.shape[1]).astype(np.float32),
+        partition=pmt.Partition.BROADCAST)
+    fwd = jax.jit(lambda v: Op.matvec(v).array)
+    y = pmt.DistributedArray.to_dist(
+        rng.standard_normal(Op.shape[0]).astype(np.float32),
+        partition=pmt.Partition.BROADCAST)
+    adj = jax.jit(lambda v: Op.rmatvec(v).array)
+    dt_f = _timeit(fwd, x, inner=5)
+    dt_a = _timeit(adj, y, inner=5)
+    flops = 8 * nfmax * ns * nr * nv
+    return {"bench": "mdc_apply",
+            "value": round(flops / dt_f / 1e9, 2), "unit": "GFLOP/s",
+            "adjoint_gflops": round(flops / dt_a / 1e9, 2),
+            "shape": f"nt{nt}xns{ns}xnr{nr}xnv{nv},nf{nfmax}"}
+
+
 def _bench_cgls_multirhs(pmt, rng, n_dev, scale):
     """GEMV → GEMM conversion: CGLS over ``nrhs`` right-hand sides at
     once (``MatrixMult(otherdims=(nrhs,))`` blocks). The single-RHS
@@ -267,6 +296,7 @@ _BENCHES = [("first_derivative_halo", _bench_first_derivative),
             ("pencil_fft2d", _bench_fft),
             ("fredholm1_batched", _bench_fredholm),
             ("poststack_inversion", _bench_poststack),
+            ("mdc_apply", _bench_mdc),
             ("cgls_multirhs", _bench_cgls_multirhs),
             # LAST: its xla-mode probe can wedge an FFT-less runtime's
             # process (benign when isolated; ordering protects the
