@@ -137,9 +137,25 @@ def numpy_cgls_iters_per_sec(blocks, y, niter=10):
     return niter / (time.perf_counter() - t0)
 
 
+def _enable_compile_cache():
+    """Persistent XLA compilation cache shared by every bench/selfcheck/
+    diag process: compiles over the remote TPU tunnel cost tens of
+    seconds each, and the harvest protocol re-runs the same programs
+    across stages and windows."""
+    try:
+        import jax
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            ".jax_cache")
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:
+        pass  # cache is an optimization, never a requirement
+
+
 def child_main():
     """The actual measurement. Runs in a supervised subprocess."""
     import jax
+    _enable_compile_cache()
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         # JAX_PLATFORMS alone is insufficient: a TPU plugin registered
         # from sitecustomize can override env-level selection, and its

@@ -51,6 +51,12 @@ def main():
     global LOG
     LOG = open(os.path.join(_ROOT, "tpu_diag_log.txt"), "w")
     import jax
+    try:  # shared persistent compile cache (see bench._enable_compile_cache)
+        cache = os.path.join(_ROOT, ".jax_cache")
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+    except Exception:
+        pass
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as P

@@ -68,6 +68,12 @@ def run_selfcheck() -> dict:
     if _ROOT not in sys.path:
         sys.path.insert(0, _ROOT)
     import jax
+    try:  # shared persistent compile cache (see bench._enable_compile_cache)
+        cache = os.path.join(_ROOT, ".jax_cache")
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+    except Exception:
+        pass
     import jax.numpy as jnp
     import pylops_mpi_tpu as pmt
     from pylops_mpi_tpu.ops import pallas_kernels as pk
