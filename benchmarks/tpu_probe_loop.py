@@ -204,9 +204,12 @@ def harvest(cache: dict) -> dict:
     env = dict(os.environ)
     rev = _code_rev()
     stages = [
+        # order: cheapest headline evidence first — a short window must
+        # bank a kernel-validity verdict and a small flagship number
+        # before the longer diagnosis/size ladder gets a chance to eat it
         ("selfcheck", lambda: _stage_selfcheck(env)),
-        ("diag", lambda: _stage_diag(env)),
         ("flagship_small", lambda: _stage_flagship(env, "small")),
+        ("diag", lambda: _stage_diag(env)),
         ("flagship_mid", lambda: _stage_flagship(env, "mid")),
         ("flagship_full", lambda: _stage_flagship(env, "full")),
     ]
