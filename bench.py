@@ -355,33 +355,6 @@ def child_main():
         ips, gflops, gbps, rel_err = f32_ips, f32_gflops, f32_gbps, f32_err
         mode = "f32 two-sweep"
 
-    if run_comps and on_tpu:
-        try:  # components must never cost the already-measured headline
-            from benchmarks.bench_components import (_run_one_isolated,
-                                                     _BENCHES,
-                                                     run_components)
-            t_comp = int(os.environ.get("BENCH_COMPONENT_TIMEOUT", "150"))
-            isolation_dead = False
-            for name, _fn in _BENCHES:
-                if not isolation_dead:
-                    _progress(f"component {name} (isolated)")
-                    r = _run_one_isolated(name, False, t_comp)
-                    err = str(r.get("error", ""))
-                    # an exclusive-access runtime rejects the second
-                    # process outright (fast rc!=0, not a timeout):
-                    # fall back to in-process for the rest — wedge risk
-                    # is acceptable now that the headline is banked
-                    if err and "timeout" not in err:
-                        isolation_dead = True
-                    else:
-                        components.append(r)
-                        continue
-                _progress(f"component {name} (in-process fallback)")
-                components.extend(run_components(quick=False, only=name))
-        except Exception as e:
-            components.append({"bench": "components",
-                               "error": repr(e)[:300]})
-
     # NumPy single-process stand-in for the reference CPU engine, timed
     # in a clean subprocess (fair BLAS threading); in-process fallback
     _progress("numpy baseline (subprocess)")
@@ -458,7 +431,7 @@ def child_main():
     peak = _peak_flops_per_chip(jax.devices()[0])
     mfu = round(gflops * 1e9 / (peak * n_dev), 4) if peak else None
 
-    print(json.dumps({
+    result = {
         "metric": f"CGLS iters/sec (BlockDiag MatrixMult, {nblk}x{nblock}^2,"
                   f" {n_dev} dev {platform}, {mode}, fused while_loop,"
                   f" marginal per-iter timing; GEMM GFLOP/s={gflops:.0f};"
@@ -482,7 +455,41 @@ def child_main():
         "components": components,
         **({"selfcheck": selfcheck} if selfcheck is not None else {}),
         **({"cpu_breakdown": cpu_breakdown} if cpu_breakdown else {}),
-    }))
+    }
+
+    if run_comps and on_tpu:
+        # bank the headline NOW: the supervisor salvages the last JSON
+        # line on timeout, so a component hang cannot cost the number
+        print(json.dumps({**result, "partial": "components pending"}),
+              flush=True)
+        try:  # components must never cost the already-measured headline
+            from benchmarks.bench_components import (_run_one_isolated,
+                                                     _BENCHES,
+                                                     run_components)
+            t_comp = int(os.environ.get("BENCH_COMPONENT_TIMEOUT", "150"))
+            isolation_dead = False
+            for name, _fn in _BENCHES:
+                if not isolation_dead:
+                    _progress(f"component {name} (isolated)")
+                    r = _run_one_isolated(name, False, t_comp)
+                    err = str(r.get("error", ""))
+                    # an exclusive-access runtime rejects the second
+                    # process outright (fast rc!=0, not a timeout):
+                    # fall back to in-process for the rest — wedge risk
+                    # is acceptable now that the headline is banked
+                    if err and "timeout" not in err:
+                        isolation_dead = True
+                    else:
+                        components.append(r)
+                        continue
+                _progress(f"component {name} (in-process fallback)")
+                components.extend(run_components(quick=False, only=name))
+        except Exception as e:
+            components.append({"bench": "components",
+                               "error": repr(e)[:300]})
+        result["components"] = components
+
+    print(json.dumps(result))
 
 
 def _run_json_cmd(cmd, env, timeout, cwd=None):
@@ -497,6 +504,20 @@ def _run_json_cmd(cmd, env, timeout, cwd=None):
     except subprocess.TimeoutExpired as e:
         tail = (e.stderr.decode("utf-8", "replace")[-1500:]
                 if isinstance(e.stderr, bytes) else str(e.stderr)[-1500:])
+        # salvage: the bench child prints a headline-only JSON line
+        # BEFORE the component sweep — a timeout mid-components must
+        # not discard an already-measured headline
+        out = (e.stdout.decode("utf-8", "replace")
+               if isinstance(e.stdout, bytes) else (e.stdout or ""))
+        for line in reversed(out.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    got = json.loads(line)
+                    got["salvaged_after_timeout"] = timeout
+                    return got, None
+                except json.JSONDecodeError:
+                    continue
         return None, f"timeout after {timeout}s; stderr tail: {tail}"
     except Exception as e:  # spawn failure itself must not crash parent
         return None, f"spawn failed: {e!r}"

@@ -215,8 +215,11 @@ def harvest(cache: dict) -> dict:
     ]
     for name, runner in stages:
         prev = cache.get(name)
+        # a salvaged "partial" headline stays usable in the cache but
+        # the stage re-runs for its missing components
         if prev and prev.get("result") is not None and \
                 prev["result"].get("platform", "tpu") == "tpu" and \
+                not prev["result"].get("partial") and \
                 not prev.get("error") and \
                 prev.get("code_rev") == rev:
             continue  # harvested on an earlier window, same code
@@ -263,6 +266,7 @@ def main() -> None:
             # must not stop the daemon from re-validating current code.
             if (res is not None and not full.get("error")
                     and res.get("platform") == "tpu"
+                    and not res.get("partial")
                     and full.get("code_rev") == _code_rev()):
                 _log({"status": "complete",
                       "note": "full TPU flagship cached; daemon exiting"})

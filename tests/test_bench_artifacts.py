@@ -166,6 +166,27 @@ def test_corrupt_cache_and_log_are_harmless(bench, tmp_path):
     assert out["probe_log"]["attempts"] == 1
 
 
+def test_run_json_cmd_salvages_on_timeout(bench):
+    """A child that prints a JSON line then hangs (the headline-first
+    bank) must yield that line, not a timeout error."""
+    code = ("import json,sys,time\n"
+            "print(json.dumps({'value': 7.5, 'partial': True}),"
+            " flush=True)\n"
+            "time.sleep(60)\n")
+    got, err = bench._run_json_cmd([sys.executable, "-c", code],
+                                   dict(os.environ), timeout=5)
+    assert err is None
+    assert got["value"] == 7.5
+    assert got["salvaged_after_timeout"] == 5
+
+
+def test_run_json_cmd_timeout_no_output(bench):
+    got, err = bench._run_json_cmd(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        dict(os.environ), timeout=3)
+    assert got is None and "timeout" in err
+
+
 def test_make_problem_deterministic(bench):
     b1, x1, y1 = bench.make_problem(2, 64, seed=0)
     b2, x2, y2 = bench.make_problem(2, 64, seed=0)
