@@ -345,12 +345,26 @@ def child_main():
     _progress(f"headline f32 (N={nblock}, {niter} iters)")
     f32_ips, f32_gflops, f32_gbps, f32_err, _ = measure(bf16=False,
                                                         fused_normal=False)
+    bf16_race = None
     if want_bf16:
         _progress("headline bf16 fused-normal")
         ips, gflops, gbps, rel_err, used_nrm = measure(bf16=True,
                                                        fused_normal=True)
         mode = ("bf16-storage fused-normal" if used_nrm
                 else "bf16-storage two-sweep")
+        if used_nrm:
+            # race the two-sweep variant: the one-HBM-sweep Pallas
+            # kernel is a theory-backed bet, but the round-3 small
+            # flagship measured it SLOWER than XLA's two GEMVs on the
+            # tunnel backend — take whichever actually wins, keep both
+            _progress("headline bf16 two-sweep (race)")
+            ips2, gflops2, gbps2, rel_err2, _ = measure(bf16=True,
+                                                        fused_normal=False)
+            bf16_race = {"fused_normal_iters_per_sec": round(ips, 2),
+                         "two_sweep_iters_per_sec": round(ips2, 2)}
+            if ips2 > ips:
+                ips, gflops, gbps, rel_err = ips2, gflops2, gbps2, rel_err2
+                mode = "bf16-storage two-sweep (won race)"
     else:
         ips, gflops, gbps, rel_err = f32_ips, f32_gflops, f32_gbps, f32_err
         mode = "f32 two-sweep"
@@ -453,6 +467,7 @@ def child_main():
         "numpy_baseline_iters_per_sec": round(cpu_ips, 2),
         "nblock": nblock,
         "components": components,
+        **({"bf16_race": bf16_race} if bf16_race else {}),
         **({"selfcheck": selfcheck} if selfcheck is not None else {}),
         **({"cpu_breakdown": cpu_breakdown} if cpu_breakdown else {}),
     }
