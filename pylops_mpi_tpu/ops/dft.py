@@ -154,8 +154,11 @@ def _bluestein_last(x: jax.Array, sign: float) -> jax.Array:
     n = x.shape[-1]
     m, chirp_np, hf_np = _bluestein_consts(n, sign, str(np.dtype(x.dtype)))
     chirp = jnp.asarray(chirp_np)
-    xp = jnp.zeros(x.shape[:-1] + (m,), x.dtype)
-    xp = xp.at[..., :n].set(x * chirp)
+    # concat, not .at[].set: scatter ops miscompile under the GSPMD
+    # partitioner on sharded operands (ops/local.py's scatter-free
+    # rule), and the generic FFT path runs dft inside partitioned code
+    xp = jnp.concatenate(
+        [x * chirp, jnp.zeros(x.shape[:-1] + (m - n,), x.dtype)], axis=-1)
     # circular convolution with the chirp kernel via the matmul engine
     # (m is a power of two → pure mixed-radix recursion, no re-entry)
     Xf = _fft_last(xp, -1.0)
