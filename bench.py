@@ -75,36 +75,50 @@ def make_problem(nblk, nblock, seed=0):
 
 
 def numpy_cgls_iters_per_sec_subprocess(nblk, nblock, seed=0, niter=10,
-                                        timeout=600):
+                                        timeout=600, k=5):
     """The NumPy stand-in timed in a CLEAN subprocess: measuring it
     inside the bench child — after XLA has claimed the host's thread
     pools — penalizes BLAS unpredictably (observed round 3: 13.5 vs
     8.4 iters/s run to run for the identical problem). The subprocess
     regenerates the same seeded blocks, so nothing large crosses the
-    pipe. Falls back to the in-process number on any failure."""
+    pipe. Falls back to the in-process number on any failure.
+
+    Returns ``(median_ips, stats-dict)`` over ``k`` repeats — round-3
+    VERDICT weak #7: a point estimate hid a noise band wider than the
+    signal; the artifact now carries the dispersion so ``vs_baseline``
+    is trustworthy (or visibly not)."""
     import subprocess
     code = (
         "import json, sys\n"
+        "import numpy as np\n"
         "sys.path.insert(0, %r)\n"
         "import bench\n"
         "blocks, xt, y = bench.make_problem(%d, %d, seed=%d)\n"
-        "r = max(bench.numpy_cgls_iters_per_sec(blocks, y, niter=%d)"
-        " for _ in range(3))\n"
-        "print(json.dumps({'ips': r}))\n"
+        "rs = sorted(bench.numpy_cgls_iters_per_sec(blocks, y, niter=%d)"
+        " for _ in range(%d))\n"
+        "print(json.dumps({'median': float(np.median(rs)),"
+        " 'min': rs[0], 'max': rs[-1]}))\n"
     ) % (os.path.dirname(os.path.abspath(__file__)), nblk, nblock, seed,
-         niter)
-    env = {k: v for k, v in os.environ.items()
-           if not k.startswith(("XLA_", "JAX_"))}
+         niter, k)
+    env = {k_: v for k_, v in os.environ.items()
+           if not k_.startswith(("XLA_", "JAX_"))}
     try:
         p = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, text=True, env=env,
                            timeout=timeout)
         for line in reversed((p.stdout or "").strip().splitlines()):
             if line.startswith("{"):
-                return float(json.loads(line)["ips"])
+                st = json.loads(line)
+                med = float(st["median"])
+                spread = ((st["max"] - st["min"]) / med * 100.0
+                          if med else 0.0)
+                return med, {"median": round(med, 2),
+                             "min": round(st["min"], 2),
+                             "max": round(st["max"], 2),
+                             "spread_pct": round(spread, 1), "k": k}
     except Exception:
         pass
-    return None
+    return None, None
 
 
 def numpy_cgls_iters_per_sec(blocks, y, niter=10):
@@ -141,11 +155,29 @@ def _enable_compile_cache():
     """Persistent XLA compilation cache shared by every bench/selfcheck/
     diag process: compiles over the remote TPU tunnel cost tens of
     seconds each, and the harvest protocol re-runs the same programs
-    across stages and windows."""
+    across stages and windows.
+
+    Namespaced by a host fingerprint: XLA's CPU AOT executables bake in
+    the compile machine's ISA features, and loading one compiled on a
+    different host warns about SIGILL risk (observed: round-3 cache
+    entries carried amx/avx512 feature sets this host lacks). A
+    per-host subdir makes stale cross-machine entries unreachable."""
     try:
+        import hashlib
+        import platform as _plat
         import jax
+        fp = _plat.machine() + "-" + _plat.processor()
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    if line.startswith("flags"):
+                        fp += line
+                        break
+        except OSError:
+            pass
+        sub = hashlib.sha256(fp.encode()).hexdigest()[:12]
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            ".jax_cache")
+                            ".jax_cache", sub)
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
     except Exception:
@@ -277,17 +309,22 @@ def child_main():
         def timed(fn):
             out = fn(dy, x0, 0.0, 0.0)
             jax.block_until_ready(out[0]._arr)
-            dt = float("inf")
+            dts = []
             for _ in range(reps):
                 t0 = time.perf_counter()
                 out = fn(dy, x0, 0.0, 0.0)
                 jax.block_until_ready(out[0]._arr)
-                dt = min(dt, time.perf_counter() - t0)
-            return dt, out
+                dts.append(time.perf_counter() - t0)
+            # min for the estimate (least-noise), full spread recorded
+            # so the artifact shows whether the band swamps the signal
+            timed.spread_pct = round((max(dts) - min(dts))
+                                     / min(dts) * 100.0, 1)
+            return min(dts), out
 
         fn1, fn3 = make_fn(niter), make_fn(3 * niter)
         t1, out = timed(fn1)
         t3, _ = timed(fn3)
+        measure.last_spread_pct = timed.spread_pct
         per_iter = (t3 - t1) / (2 * niter)
         if per_iter <= 0:
             # tunnel noise swamped the slope: retry the timing (the
@@ -316,10 +353,15 @@ def child_main():
     # process it runs in, and in round 3 that cost the entire
     # full-flagship stage; headline first means the number that matters
     # is banked before any component can misbehave.
+    # BENCH_SIMULATE_TPU_ORDERING=1 forces the TPU ordering off-TPU so
+    # the harvest-ladder rehearsal can exercise headline-first banking
+    # and timeout salvage without hardware (round-3 VERDICT next #3).
+    tpu_like = on_tpu or os.environ.get(
+        "BENCH_SIMULATE_TPU_ORDERING") == "1"
     components = []
     run_comps = os.environ.get("BENCH_COMPONENTS_PYLOPS_MPI_TPU",
                                "1") != "0"
-    if run_comps and not on_tpu:
+    if run_comps and not tpu_like:
         try:
             from benchmarks.bench_components import (
                 run_components, retry_failed_isolated)
@@ -335,47 +377,66 @@ def child_main():
         # pinned operator buffers) before the memory-heaviest solve
         pmt.clear_fused_cache()
 
-    # bf16 block storage (the native TPU matrix format) halves HBM
-    # traffic of the memory-bound matvec; MXU accumulates in f32. The
-    # f32 classic path is ALWAYS measured alongside for apples-to-apples
-    # baseline comparison. BENCH_F32_PYLOPS_MPI_TPU=1 makes f32 primary.
-    want_bf16 = (on_tpu and allow_bf16_storage
-                 and os.environ.get("BENCH_F32_PYLOPS_MPI_TPU",
-                                    "0") != "1")
+    # Headline policy (round-3 VERDICT weak #4): **f32 is primary** —
+    # vs_baseline compares against an f32 NumPy solve and the BASELINE
+    # target is bit-meaningful CGLS convergence, which bf16 storage
+    # (~2.5e-3 rel_err measured round 3) does not deliver. bf16 block
+    # storage (native TPU matrix format, half the HBM traffic) is still
+    # measured and reported as a labeled secondary; set
+    # BENCH_PRIMARY_PYLOPS_MPI_TPU=bf16 to flip, or
+    # BENCH_BF16_PYLOPS_MPI_TPU=0 to skip the bf16 pass entirely.
+    measure_bf16 = (on_tpu and allow_bf16_storage
+                    and os.environ.get("BENCH_BF16_PYLOPS_MPI_TPU",
+                                       "1") != "0"
+                    and os.environ.get("BENCH_F32_PYLOPS_MPI_TPU",
+                                       "0") != "1")
+    primary_bf16 = (measure_bf16
+                    and os.environ.get("BENCH_PRIMARY_PYLOPS_MPI_TPU",
+                                       "f32") == "bf16")
     _progress(f"headline f32 (N={nblock}, {niter} iters)")
     f32_ips, f32_gflops, f32_gbps, f32_err, _ = measure(bf16=False,
                                                         fused_normal=False)
+    f32_spread = getattr(measure, "last_spread_pct", None)
     bf16_race = None
-    if want_bf16:
+    bf16_res = None
+    if measure_bf16:
         _progress("headline bf16 fused-normal")
-        ips, gflops, gbps, rel_err, used_nrm = measure(bf16=True,
-                                                       fused_normal=True)
-        mode = ("bf16-storage fused-normal" if used_nrm
-                else "bf16-storage two-sweep")
+        b_ips, b_gflops, b_gbps, b_err, used_nrm = measure(
+            bf16=True, fused_normal=True)
+        b_mode = ("bf16-storage fused-normal" if used_nrm
+                  else "bf16-storage two-sweep")
         if used_nrm:
             # race the two-sweep variant: the one-HBM-sweep Pallas
             # kernel is a theory-backed bet, but the round-3 small
             # flagship measured it SLOWER than XLA's two GEMVs on the
             # tunnel backend — take whichever actually wins, keep both
             _progress("headline bf16 two-sweep (race)")
-            ips2, gflops2, gbps2, rel_err2, _ = measure(bf16=True,
-                                                        fused_normal=False)
-            bf16_race = {"fused_normal_iters_per_sec": round(ips, 2),
+            ips2, gflops2, gbps2, err2, _ = measure(bf16=True,
+                                                    fused_normal=False)
+            bf16_race = {"fused_normal_iters_per_sec": round(b_ips, 2),
                          "two_sweep_iters_per_sec": round(ips2, 2)}
-            if ips2 > ips:
-                ips, gflops, gbps, rel_err = ips2, gflops2, gbps2, rel_err2
-                mode = "bf16-storage two-sweep (won race)"
+            if ips2 > b_ips:
+                b_ips, b_gflops, b_gbps, b_err = ips2, gflops2, gbps2, err2
+                b_mode = "bf16-storage two-sweep (won race)"
+        bf16_res = {"iters_per_sec": round(b_ips, 2),
+                    "gflops": round(b_gflops, 1),
+                    "hbm_gbps": round(b_gbps, 1),
+                    "rel_err": f"{b_err:.1e}", "mode": b_mode}
+    if primary_bf16 and bf16_res is not None:
+        ips, gflops, gbps, rel_err, mode = (b_ips, b_gflops, b_gbps,
+                                            b_err, b_mode)
     else:
         ips, gflops, gbps, rel_err = f32_ips, f32_gflops, f32_gbps, f32_err
         mode = "f32 two-sweep"
 
     # NumPy single-process stand-in for the reference CPU engine, timed
     # in a clean subprocess (fair BLAS threading); in-process fallback
-    _progress("numpy baseline (subprocess)")
-    cpu_ips = numpy_cgls_iters_per_sec_subprocess(nblk, nblock, seed=0,
-                                                  niter=10)
+    _progress("numpy baseline (subprocess, median-of-k)")
+    cpu_ips, cpu_stats = numpy_cgls_iters_per_sec_subprocess(
+        nblk, nblock, seed=0, niter=10)
     if cpu_ips is None:
         cpu_ips = numpy_cgls_iters_per_sec(blocks_np, y_np, niter=10)
+        cpu_stats = {"note": "in-process fallback, single run"}
 
     # Degraded-CPU provenance (round-2 VERDICT weak #1): separate the
     # three candidate explanations for trailing the NumPy stand-in —
@@ -463,16 +524,20 @@ def child_main():
                 "gflops": round(f32_gflops, 1),
                 "hbm_gbps": round(f32_gbps, 1),
                 "vs_baseline": round(f32_ips / cpu_ips, 2),
-                "rel_err": f"{f32_err:.1e}"},
+                "rel_err": f"{f32_err:.1e}",
+                **({"spread_pct": f32_spread}
+                   if f32_spread is not None else {})},
         "numpy_baseline_iters_per_sec": round(cpu_ips, 2),
+        **({"numpy_baseline_stats": cpu_stats} if cpu_stats else {}),
         "nblock": nblock,
         "components": components,
+        **({"bf16": bf16_res} if bf16_res else {}),
         **({"bf16_race": bf16_race} if bf16_race else {}),
         **({"selfcheck": selfcheck} if selfcheck is not None else {}),
         **({"cpu_breakdown": cpu_breakdown} if cpu_breakdown else {}),
     }
 
-    if run_comps and on_tpu:
+    if run_comps and tpu_like:
         # bank the headline NOW: the supervisor salvages the last JSON
         # line on timeout, so a component hang cannot cost the number
         print(json.dumps({**result, "partial": "components pending"}),
@@ -585,6 +650,33 @@ def _tpu_probe(timeout: int):
         return "dead", repr(e)[:300]
 
 
+# the rev key must change when CODE changes, not when artifacts do:
+# keying on HEAD would invalidate banked 40-minute stages every time
+# log/cache files (or docs) get committed. Shared with the probe daemon.
+_CODE_PATHS = ["pylops_mpi_tpu", "benchmarks", "bench.py",
+               "__graft_entry__.py"]
+
+
+def _current_code_rev() -> str:
+    try:
+        root = os.path.dirname(os.path.abspath(__file__))
+        trees = []
+        for p in _CODE_PATHS:
+            r = subprocess.run(["git", "rev-parse", f"HEAD:{p}"],
+                               capture_output=True, text=True, cwd=root,
+                               timeout=10)
+            trees.append(r.stdout.strip()[:12] if r.returncode == 0
+                         else "none")
+        d = subprocess.run(["git", "status", "--porcelain", "--"]
+                           + _CODE_PATHS,
+                           capture_output=True, text=True, cwd=root,
+                           timeout=10).stdout.strip()
+        key = "-".join(t[:7] for t in trees)
+        return key + ("+dirty" if d else "")
+    except Exception:
+        return "unknown"
+
+
 def _probe_log_summary(root=None):
     """Summarize tpu_probe_log.jsonl (written by
     benchmarks/tpu_probe_loop.py all round): attempt counts per status
@@ -649,15 +741,58 @@ def _merge_tpu_cache(result, root=None):
                 result["cache_stage"] = key
                 result["cache_ts"] = ent.get("ts")
                 result["cpu_live"] = cpu_live
+                # headline policy (round 4): f32 primary. A cache entry
+                # banked under the old bf16-primary policy carries the
+                # f32 numbers alongside — re-rank instead of re-running
+                f32 = result.get("f32") or {}
+                if (f32.get("iters_per_sec") is not None
+                        and "f32" not in str(result.get("metric", ""))
+                        and "bf16" in str(result.get("metric", ""))):
+                    result["bf16"] = {
+                        "iters_per_sec": result.get("value"),
+                        "rel_err": (result.get("metric", "").split(
+                            "rel_err=")[-1].rstrip(")")
+                            if "rel_err=" in result.get("metric", "")
+                            else None),
+                        "mode": "bf16 (was primary when banked)"}
+                    old_gflops = result.get("gflops")
+                    old_mfu = result.get("mfu")
+                    result["value"] = f32["iters_per_sec"]
+                    result["vs_baseline"] = f32.get("vs_baseline")
+                    result["hbm_gbps"] = f32.get("hbm_gbps")
+                    result["gflops"] = f32.get("gflops")
+                    # mfu was computed from the banked PRIMARY mode's
+                    # gflops — rescale to f32's or drop it, never pair
+                    # f32 throughput with bf16 utilization
+                    if old_mfu and old_gflops and f32.get("gflops"):
+                        result["mfu"] = round(
+                            old_mfu * f32["gflops"] / old_gflops, 4)
+                    else:
+                        result["mfu"] = None
+                    result["metric"] = (
+                        result.get("metric", "") +
+                        " [f32 promoted to primary per round-4 policy]")
                 break
     if "selfcheck" not in result:
         ent = cache.get("selfcheck") or {}
         r = ent.get("result")
         # only a selfcheck that actually ran on TPU counts as hardware
         # kernel validation — a tunnel drop makes the child silently
-        # fall back to CPU interpret mode, which proves nothing
+        # fall back to CPU interpret mode, which proves nothing.
+        # A result harvested from OLDER code is still evidence but must
+        # not read as a verdict on the current kernels (round-3 weak #5:
+        # the wedge-poisoned selfcheck sat in the cache keyed to an old
+        # rev) — mark it stale so nothing downstream gates on it.
         if r and r.get("platform") == "tpu":
-            result["selfcheck"] = {**r, "cached": True}
+            result["selfcheck"] = {**r, "cached": True,
+                                   "code_rev": ent.get("code_rev")}
+            if ent.get("code_rev") != _current_code_rev():
+                result["selfcheck"]["stale"] = True
+    ent = cache.get("breakdown") or {}
+    r = ent.get("result")
+    if r and r.get("platform") == "tpu" and "tpu_breakdown" not in result:
+        result["tpu_breakdown"] = {**r, "cached": True,
+                                   "ts": ent.get("ts")}
     ent = cache.get("diag") or {}
     r = ent.get("result")
     # same hardware-evidence rule as the selfcheck merge above: a diag
@@ -673,6 +808,88 @@ def _merge_tpu_cache(result, root=None):
     if summary:
         result["probe_log"] = summary
     return result
+
+
+def _emit_final(result):
+    """Write the FULL artifact to ``bench_detail.json`` and print a
+    compact (≤2 KB) summary as the LAST stdout line. Round-3 failure
+    being fixed: the driver records only a stdout tail, and the full
+    JSON (components + probe log + selfcheck) overflowed it, leaving
+    ``BENCH_r03.json`` with ``"parsed": null``."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(root, "bench_detail.json"), "w") as f:
+            json.dump(result, f, indent=1)
+    except Exception:
+        pass  # detail file is best-effort; the summary line is not
+
+    sc = result.get("selfcheck") or {}
+    checks = sc.get("checks") or {}
+    comps = [c for c in (result.get("components") or [])
+             if isinstance(c, dict)]
+    bd = result.get("tpu_breakdown") or {}
+    probe = result.get("probe_log") or {}
+    compact = {
+        "metric": result.get("metric", ""),
+        "value": result.get("value"),
+        "unit": result.get("unit"),
+        "vs_baseline": result.get("vs_baseline"),
+        "mfu": result.get("mfu"),
+        "hbm_gbps": result.get("hbm_gbps"),
+        "gflops": result.get("gflops"),
+        "platform": result.get("platform"),
+        "n_devices": result.get("n_devices"),
+        "nblock": result.get("nblock"),
+        "numpy_baseline_iters_per_sec":
+            result.get("numpy_baseline_iters_per_sec"),
+        "detail_file": "bench_detail.json",
+    }
+    for k in ("degraded", "cached", "cache_stage", "partial",
+              "salvaged_after_timeout"):
+        if result.get(k):
+            compact[k] = result[k]
+    if "f32" in result:
+        compact["f32"] = {k: result["f32"].get(k) for k in
+                          ("iters_per_sec", "vs_baseline", "hbm_gbps")}
+    if result.get("bf16"):
+        compact["bf16"] = {k: result["bf16"].get(k) for k in
+                           ("iters_per_sec", "rel_err", "mode")}
+    if result.get("bf16_race"):
+        compact["bf16_race"] = result["bf16_race"]
+    if sc:
+        n_ok = sum(1 for v in checks.values()
+                   if isinstance(v, dict) and v.get("ok"))
+        compact["selfcheck"] = {
+            "platform": sc.get("platform"), "ok": n_ok,
+            "total": len(checks) or None,
+            **({"stale": True} if sc.get("stale") else {}),
+            **({"cached": True} if sc.get("cached") else {})}
+    if comps:
+        failed = [c.get("bench") for c in comps if c.get("error")]
+        compact["components"] = {"n": len(comps),
+                                 **({"failed": failed} if failed else {})}
+    if bd:
+        nf = bd.get("niter_fit") or {}
+        compact["tpu_breakdown"] = {
+            "per_iter_ms": nf.get("per_iter_ms"),
+            "fixed_ms": nf.get("fixed_ms"),
+            "sweep_ms": bd.get("sweep_ms"),
+            "vs_sweep": bd.get("while_loop_marginal_vs_sweep"),
+            "reduction_ms": bd.get("reduction_overhead_per_iter_ms"),
+            "dispatch_ms": bd.get("dispatch_ms")}
+    if probe:
+        compact["probe"] = {"attempts": probe.get("attempts"),
+                            "statuses": probe.get("statuses"),
+                            "last_ts": probe.get("last_ts")}
+    # hard ≤2KB guarantee: shed optional detail, most-expendable first
+    for victim in ("probe", "components", "bf16_race", "bf16", "f32",
+                   "tpu_breakdown", "selfcheck"):
+        if len(json.dumps(compact)) <= 2000:
+            break
+        compact.pop(victim, None)
+    if len(json.dumps(compact)) > 2000:
+        compact["metric"] = compact.get("metric", "")[:120]
+    print(json.dumps(compact))
 
 
 def main():
@@ -714,7 +931,7 @@ def main():
             # artifact, so the two can never disagree.
             merged = _merge_tpu_cache(dict(result))
             if merged.get("cached"):
-                print(json.dumps(merged))
+                _emit_final(merged)
                 return
             env1 = dict(os.environ)
             env1["JAX_PLATFORMS"] = "cpu"
@@ -743,7 +960,7 @@ def main():
                 "cpu_error": (err2 or "")[:600],
             }
     result = _merge_tpu_cache(result)
-    print(json.dumps(result))
+    _emit_final(result)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,181 @@
+"""Flagship latency breakdown — answers round-3 VERDICT weak #1.
+
+The one real-TPU headline so far (N=1024 small flagship, 1,339 iters/s
+f32) corresponds to ~0.75 ms per CGLS iteration where the HBM roofline
+says ~10 us: 1.4% of bandwidth. This stage attributes that gap with
+measurements instead of guesses, separating:
+
+- ``dispatch_ms`` — cost of ONE jitted no-op round trip (tunnel RPC
+  floor; on local backends this is ~0.05 ms);
+- the **fixed-vs-marginal fit** — absolute solve wall time at several
+  ``niter`` values, least-squares fit ``t = fixed + per_iter * n``. A
+  huge ``fixed`` with tiny ``per_iter`` means dispatch/transfer
+  overhead dominated the headline and the marginal-timing slope in
+  bench.py is trustworthy; a large ``per_iter`` means the while_loop
+  body itself is slow on-chip (fusion / layout / precision problem);
+- ``matvec_ms`` / ``sweep_ms`` — one standalone jitted matvec and one
+  matvec+rmatvec sweep, the lower bound any CGLS iteration can hit;
+- ``while_loop_marginal_vs_sweep`` — the smoking-gun ratio: fused
+  per-iteration time over standalone sweep time. ~1 means the loop is
+  resident and each iteration costs what its memory traffic costs;
+  >> 1 means iterations pay a per-step penalty (loop not resident /
+  per-iteration sync in the backend runtime);
+- ``cost_analysis`` — XLA's own FLOP/byte estimate for the compiled
+  solve, so expected bandwidth time is derivable from the artifact.
+
+Runs anywhere (CPU rehearsal = methodology validation; TPU window =
+the actual diagnosis). Prints ONE JSON line; wired into the probe
+daemon ladder after the small flagship and merged into bench.py's
+artifact under ``tpu_breakdown``.
+
+Reference for the number being diagnosed: tpu_cache.json
+flagship_small (round 3) and ``bench.py`` ``measure()``'s marginal
+timing. Ref solver being timed: the analog of
+``pylops_mpi/optimization/cls_basic.py:370-404``.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+
+def main() -> None:
+    import bench
+    bench._enable_compile_cache()
+    import jax
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import pylops_mpi_tpu as pmt
+    from pylops_mpi_tpu.ops.local import MatrixMult
+    from pylops_mpi_tpu.solvers.basic import _cgls_fused
+
+    platform = jax.default_backend()
+    n_dev = len(jax.devices())
+    mesh = pmt.make_mesh()
+    pmt.set_default_mesh(mesh)
+
+    nblk = max(n_dev, 1)
+    nblock = int(os.environ.get("BREAKDOWN_NBLOCK", "1024"))
+    reps = int(os.environ.get("BREAKDOWN_REPS", "7"))
+    out = {"platform": platform, "n_devices": n_dev, "nblock": nblock}
+
+    def best(f, r=reps):
+        f()  # warmup/compile
+        dt = float("inf")
+        for _ in range(r):
+            t0 = time.perf_counter()
+            f()
+            dt = min(dt, time.perf_counter() - t0)
+        return dt
+
+    # 1. dispatch floor: smallest possible jitted program
+    one = jnp.zeros(())
+    noop = jax.jit(lambda v: v + 1.0)
+    out["dispatch_ms"] = round(
+        best(lambda: jax.block_until_ready(noop(one))) * 1e3, 3)
+
+    # 2. the flagship operator at this size
+    blocks_np, xtrue, y_np = bench.make_problem(nblk, nblock, seed=0)
+    blocks_dev = [jnp.asarray(b) for b in blocks_np]
+    jax.block_until_ready(blocks_dev[-1])
+    Op = pmt.MPIBlockDiag([MatrixMult(b, dtype=np.float32)
+                           for b in blocks_dev])
+    dy = pmt.DistributedArray.to_dist(y_np, mesh=mesh)
+    x0 = pmt.DistributedArray.to_dist(np.zeros_like(xtrue), mesh=mesh)
+
+    mv = jax.jit(lambda v: Op.matvec(v)._arr)
+    out["matvec_ms"] = round(
+        best(lambda: jax.block_until_ready(mv(dy))) * 1e3, 3)
+    sweep = jax.jit(lambda v: Op.rmatvec(Op.matvec(v))._arr)
+    t_sweep = best(lambda: jax.block_until_ready(sweep(dx := dy)))
+    out["sweep_ms"] = round(t_sweep * 1e3, 3)
+
+    # 3. fixed-vs-marginal fit over niter
+    niters = [int(v) for v in os.environ.get(
+        "BREAKDOWN_NITERS", "1,5,20,60").split(",")]
+    points = []
+    for nit in niters:
+        fn = jax.jit(lambda y, x, damp, tol, _n=nit:
+                     _cgls_fused(Op, y, x, _n, damp, tol))
+        t = best(lambda: jax.block_until_ready(fn(dy, x0, 0.0, 0.0)[0]._arr))
+        points.append({"niter": nit, "ms": round(t * 1e3, 3)})
+    ns = np.array([p["niter"] for p in points], dtype=float)
+    ts = np.array([p["ms"] for p in points], dtype=float) / 1e3
+    A = np.stack([np.ones_like(ns), ns], axis=1)
+    (fixed, per_iter), *_ = np.linalg.lstsq(A, ts, rcond=None)
+    pred = A @ np.array([fixed, per_iter])
+    ss_res = float(np.sum((ts - pred) ** 2))
+    ss_tot = float(np.sum((ts - ts.mean()) ** 2)) or 1e-30
+    out["niter_fit"] = {
+        "points": points,
+        "fixed_ms": round(float(fixed) * 1e3, 3),
+        "per_iter_ms": round(float(per_iter) * 1e3, 4),
+        "r2": round(1.0 - ss_res / ss_tot, 4),
+    }
+    out["iters_per_sec_marginal"] = (
+        round(1.0 / per_iter, 1) if per_iter > 0 else None)
+    # the smoking gun: a resident while_loop iteration should cost about
+    # one standalone matvec+rmatvec sweep (plus small reduction work)
+    out["while_loop_marginal_vs_sweep"] = (
+        round(float(per_iter) / t_sweep, 2) if t_sweep > 0 else None)
+
+    # 3b. the same fit for a reduction-free loop (two operator sweeps
+    # per iteration, NO dots/norms/cost history): separates GEMV time
+    # from the scalar-reduction + bookkeeping cost of the real body
+    from jax import lax
+
+    def _sweeps_only(v, n):
+        def body(_, c):
+            return Op.rmatvec(Op.matvec(c)) * 0.5
+        return lax.fori_loop(0, n, body, v)
+
+    pts2 = []
+    for nit in niters:
+        fn = jax.jit(lambda v, _n=nit: _sweeps_only(v, _n)._arr)
+        t = best(lambda: jax.block_until_ready(fn(x0)))
+        pts2.append({"niter": nit, "ms": round(t * 1e3, 3)})
+    ts2 = np.array([p["ms"] for p in pts2], dtype=float) / 1e3
+    (fixed2, per_iter2), *_ = np.linalg.lstsq(A, ts2, rcond=None)
+    out["sweeps_only_fit"] = {
+        "points": pts2, "fixed_ms": round(float(fixed2) * 1e3, 3),
+        "per_iter_ms": round(float(per_iter2) * 1e3, 4)}
+    if per_iter2 > 0:
+        out["reduction_overhead_per_iter_ms"] = round(
+            float(per_iter - per_iter2) * 1e3, 4)
+
+    # 4. XLA's own estimate for the 60-iter solve
+    try:
+        lowered = jax.jit(
+            lambda y, x: _cgls_fused(Op, y, x, niters[-1], 0.0, 0.0)
+        ).lower(dy, x0)
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        keep = {k: float(v) for k, v in (ca or {}).items()
+                if k in ("flops", "bytes accessed", "transcendentals",
+                         "optimal_seconds", "utilization operand 0 {}")}
+        out["cost_analysis"] = keep or None
+    except Exception as e:
+        out["cost_analysis"] = {"error": repr(e)[:200]}
+
+    # 5. expected memory-bound per-iter time at the quoted HBM bandwidth,
+    # for the artifact to carry its own roofline context
+    hbm_gbps = {"tpu": 819.0}.get(platform)  # v5e spec
+    if hbm_gbps:
+        bytes_per_iter = 2 * nblock * nblock * nblk * 4  # 2 f32 sweeps
+        out["roofline_per_iter_ms"] = round(
+            bytes_per_iter / (hbm_gbps * 1e9) * 1e3, 4)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -143,6 +143,16 @@ def _stage_diag(env):
                 "diag timeout" if steps else "diag timeout with no steps")
 
 
+def _stage_breakdown(env):
+    """Latency attribution for the flagship (benchmarks/tpu_breakdown.py):
+    fixed-vs-marginal niter fit, standalone sweep time, reduction
+    overhead — the round-3 weak-#1 diagnosis, on hardware."""
+    return _bench_mod()._run_json_cmd(
+        [sys.executable, os.path.join(_HERE, "tpu_breakdown.py")], env,
+        timeout=int(os.environ.get("PROBE_BREAKDOWN_TIMEOUT", "900")),
+        cwd=_ROOT)
+
+
 def _stage_flagship(env, size: str):
     env = dict(env)
     if size == "small":
@@ -167,41 +177,46 @@ def _stage_flagship(env, size: str):
         env, timeout=timeout, cwd=_ROOT)
 
 
-# the rev key must change when CODE changes, not when artifacts do:
-# keying on HEAD would invalidate banked 40-minute stages every time the
-# daemon's own log/cache files (or docs) get committed
-_CODE_PATHS = ["pylops_mpi_tpu", "benchmarks", "bench.py",
-               "__graft_entry__.py"]
-
-
 def _code_rev() -> str:
-    import subprocess
-    try:
-        trees = []
-        for p in _CODE_PATHS:
-            r = subprocess.run(["git", "rev-parse", f"HEAD:{p}"],
-                               capture_output=True, text=True, cwd=_ROOT,
-                               timeout=10)
-            trees.append(r.stdout.strip()[:12] if r.returncode == 0
-                         else "none")
-        d = subprocess.run(["git", "status", "--porcelain", "--"]
-                           + _CODE_PATHS,
-                           capture_output=True, text=True, cwd=_ROOT,
-                           timeout=10).stdout.strip()
-        key = "-".join(t[:7] for t in trees)
-        return key + ("+dirty" if d else "")
-    except Exception:
-        return "unknown"
+    """Git tree hash over the code paths (not artifacts/docs) — one
+    implementation, shared with bench.py's stale-cache marking."""
+    return _bench_mod()._current_code_rev()
 
 
-def harvest(cache: dict) -> dict:
+def rehearse_env(env: dict) -> dict:
+    """The ONE definition of the CPU-rehearsal environment (forced CPU
+    platform, 8-virtual-device mesh, TPU-style headline-first component
+    ordering) — shared by :func:`harvest` and
+    ``benchmarks/rehearse_ladder.py`` so the two can't drift."""
+    env = dict(env)
+    env["BENCH_FORCE_CPU"] = "1"
+    env["PYLOPS_MPI_TPU_PLATFORM"] = "cpu"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SIMULATE_TPU_ORDERING"] = "1"
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    return env
+
+
+def harvest(cache: dict, rehearse: bool = False) -> dict:
     """One live window: run whatever stages aren't cached yet; persist
     after each. Returns the updated cache. Cached entries are keyed to
     the git revision that produced them — a stage harvested from older
     code re-runs so fixes get re-validated on hardware (the flagship
     artifact-merge in bench.py still falls back to any-rev cached TPU
-    numbers, old beats none)."""
-    env = dict(os.environ)
+    numbers, old beats none).
+
+    ``rehearse``: run the EXACT stage ladder on CPU (forced platform,
+    8-virtual-device mesh, TPU-style headline-first component ordering)
+    so the whole window protocol — budgets, banking, salvage — is
+    provable without hardware. Rehearsal results carry platform "cpu"
+    and are never promoted by bench.py's cache merge; point
+    TPU_PROBE_DIR somewhere disposable to keep the real cache clean."""
+    env = rehearse_env(dict(os.environ)) if rehearse \
+        else dict(os.environ)
+    expected_platform = "cpu" if rehearse else "tpu"
     rev = _code_rev()
     stages = [
         # order: cheapest headline evidence first — a short window must
@@ -209,16 +224,26 @@ def harvest(cache: dict) -> dict:
         # before the longer diagnosis/size ladder gets a chance to eat it
         ("selfcheck", lambda: _stage_selfcheck(env)),
         ("flagship_small", lambda: _stage_flagship(env, "small")),
+        ("breakdown", lambda: _stage_breakdown(env)),
         ("diag", lambda: _stage_diag(env)),
         ("flagship_mid", lambda: _stage_flagship(env, "mid")),
         ("flagship_full", lambda: _stage_flagship(env, "full")),
     ]
     for name, runner in stages:
         prev = cache.get(name)
+        # a rehearsal must NEVER overwrite banked hardware evidence —
+        # a real-TPU entry outranks any CPU rehearsal result even when
+        # TPU_PROBE_DIR wasn't redirected to a disposable dir
+        if rehearse and prev and (prev.get("result") or {}).get(
+                "platform") == "tpu":
+            _log({"status": "stage_skipped", "stage": name,
+                  "note": "rehearse refuses to overwrite TPU entry"})
+            continue
         # a salvaged "partial" headline stays usable in the cache but
         # the stage re-runs for its missing components
         if prev and prev.get("result") is not None and \
-                prev["result"].get("platform", "tpu") == "tpu" and \
+                prev["result"].get("platform", expected_platform) \
+                == expected_platform and \
                 not prev["result"].get("partial") and \
                 not prev.get("error") and \
                 prev.get("code_rev") == rev:
@@ -240,22 +265,90 @@ def harvest(cache: dict) -> dict:
     return cache
 
 
+_SELF = os.path.abspath(__file__)
+
+
+def _self_hash() -> str:
+    # covers bench.py too: the daemon imports it once (probe +
+    # JSON-subprocess helpers) and would otherwise keep running a
+    # stale copy after an edit
+    import hashlib
+    h = hashlib.sha256()
+    for path in (_SELF, os.path.join(_ROOT, "bench.py")):
+        try:
+            with open(path, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"gone")
+    return h.hexdigest()[:16]
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--interval", type=int, default=180)
     ap.add_argument("--max-hours", type=float, default=11.0)
     ap.add_argument("--once", action="store_true")
     ap.add_argument("--probe-timeout", type=int, default=120)
+    ap.add_argument("--deadline-ts", type=float, default=0.0,
+                    help="absolute wall deadline (epoch s); survives "
+                         "re-exec, overrides --max-hours when set")
+    ap.add_argument("--rehearse", action="store_true",
+                    help="treat a live CPU probe as a window and run "
+                         "the full stage ladder on CPU (see harvest)")
     args = ap.parse_args()
 
-    deadline = time.time() + args.max_hours * 3600
+    if args.rehearse and not os.environ.get("TPU_PROBE_DIR"):
+        # auto-redirect rehearsal artifacts: the real tpu_cache.json /
+        # probe log must stay pristine even on a bare `--rehearse` run
+        global LOG_PATH, CACHE_PATH
+        rd = os.path.join(_HERE, ".rehearsal")
+        os.makedirs(rd, exist_ok=True)
+        LOG_PATH = os.path.join(rd, "tpu_probe_log.jsonl")
+        CACHE_PATH = os.path.join(rd, "tpu_cache.json")
+
+    deadline = args.deadline_ts or (time.time() + args.max_hours * 3600)
+    # CPython caches the module object loaded at start; stage children
+    # spawn bench.py / tpu_selfcheck.py from DISK so they always run
+    # current code, but this loop's own logic wouldn't.  Guard against
+    # a stale daemon eating the round's only live window (round-3
+    # verdict, weak #8): before every probe, compare the on-disk file
+    # hash with the one recorded at start and re-exec from disk on any
+    # change, carrying the absolute deadline through.
+    boot_hash = _self_hash()
     _log({"status": "daemon_start", "interval": args.interval,
-          "max_hours": args.max_hours})
+          "max_hours": args.max_hours, "self_hash": boot_hash,
+          "deadline_ts": round(deadline, 1)})
     while True:
+        if _self_hash() != boot_hash:
+            # debounce a half-written file (editor/Write mid-replace),
+            # then refuse to exec into something that can't compile —
+            # a failed refresh must degrade to "keep running stale",
+            # never kill the round-long harvest loop
+            time.sleep(2)
+            new_hash = _self_hash()
+            if new_hash != boot_hash:
+                try:
+                    for path in (_SELF, os.path.join(_ROOT, "bench.py")):
+                        with open(path) as f:
+                            compile(f.read(), path, "exec")
+                    _log({"status": "daemon_reexec",
+                          "note": "code changed on disk",
+                          "self_hash": new_hash})
+                    os.execv(sys.executable, [
+                        sys.executable, _SELF,
+                        "--interval", str(args.interval),
+                        "--probe-timeout", str(args.probe_timeout),
+                        "--max-hours", str(args.max_hours),
+                        "--deadline-ts", str(deadline)]
+                        + (["--once"] if args.once else [])
+                        + (["--rehearse"] if args.rehearse else []))
+                except Exception as e:
+                    _log({"status": "daemon_reexec_skipped",
+                          "error": repr(e)[:200]})
         status, detail = probe(args.probe_timeout)
         _log({"status": status, **({"detail": detail} if detail else {})})
-        if status == "tpu":
-            cache = harvest(_load_cache())
+        if status == "tpu" or (args.rehearse and status != "dead"):
+            cache = harvest(_load_cache(), rehearse=args.rehearse)
             full = cache.get("flagship_full", {})
             res = full.get("result")
             # platform must really be "tpu": a tunnel drop mid-stage

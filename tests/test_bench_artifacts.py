@@ -197,3 +197,57 @@ def test_make_problem_deterministic(bench):
     got = np.concatenate([b @ x1[i * 64:(i + 1) * 64]
                           for i, b in enumerate(b1)])
     np.testing.assert_allclose(got, y1, rtol=1e-6)
+
+
+def test_cached_bf16_primary_reranked_to_f32(bench, tmp_path):
+    """Round-4 headline policy: a cache entry banked under the old
+    bf16-primary policy is re-ranked to f32 at merge time, with mfu
+    rescaled to the f32 rate (never f32 throughput + bf16 MFU)."""
+    import json
+    cache = {"flagship_small": {"ts": "t", "code_rev": "r", "result": {
+        "platform": "tpu",
+        "metric": "CGLS iters/sec (bf16-storage fused-normal,"
+                  " rel_err=2.5e-03)",
+        "value": 772.0, "unit": "iters/s", "vs_baseline": 0.31,
+        "mfu": 0.02, "gflops": 3.2, "hbm_gbps": 1.6,
+        "f32": {"iters_per_sec": 1339.0, "vs_baseline": 0.53,
+                "gflops": 5.6, "hbm_gbps": 11.2, "rel_err": "1e-06"},
+    }}}
+    (tmp_path / "tpu_cache.json").write_text(json.dumps(cache))
+    merged = bench._merge_tpu_cache(
+        {"platform": "cpu", "value": 12.0, "degraded": True},
+        root=str(tmp_path))
+    assert merged["cached"] and merged["value"] == 1339.0
+    assert merged["vs_baseline"] == 0.53
+    assert merged["gflops"] == 5.6
+    # mfu rescaled by f32/bf16 gflops ratio: 0.02 * 5.6/3.2 = 0.035
+    assert abs(merged["mfu"] - 0.035) < 1e-9
+    assert merged["bf16"]["iters_per_sec"] == 772.0
+    assert "f32 promoted" in merged["metric"]
+
+
+def test_rehearse_never_overwrites_tpu_cache(tmp_path, monkeypatch):
+    """harvest(rehearse=True) must refuse to replace banked hardware
+    entries even when pointed at the real cache dir."""
+    import importlib.util as ilu
+    monkeypatch.setenv("TPU_PROBE_DIR", str(tmp_path))
+    spec = ilu.spec_from_file_location(
+        "tpl_mod", os.path.join(_ROOT, "benchmarks",
+                                "tpu_probe_loop.py"))
+    tpl = ilu.module_from_spec(spec)
+    spec.loader.exec_module(tpl)
+    tpu_entry = {"ts": "t", "code_rev": "old", "result": {
+        "platform": "tpu", "checks": {"x": {"ok": True}}}}
+    cache = {"selfcheck": dict(tpu_entry)}
+    (tmp_path / "tpu_cache.json").write_text(json.dumps(cache))
+    # every stage runner would re-run (rev mismatch) and fail fast off
+    # TPU; the point is the tpu-platform entry must survive untouched
+    monkeypatch.setenv("PROBE_SELFCHECK_TIMEOUT", "5")
+    monkeypatch.setenv("PROBE_SMALL_TIMEOUT", "5")
+    monkeypatch.setenv("PROBE_BREAKDOWN_TIMEOUT", "5")
+    monkeypatch.setenv("PROBE_DIAG_TIMEOUT", "5")
+    monkeypatch.setenv("PROBE_MID_TIMEOUT", "5")
+    monkeypatch.setenv("PROBE_FULL_TIMEOUT", "5")
+    out = tpl.harvest(dict(cache), rehearse=True)
+    assert out["selfcheck"]["result"]["platform"] == "tpu"
+    assert out["selfcheck"]["code_rev"] == "old"
