@@ -163,35 +163,45 @@ def _bench_dft_engine(pmt, rng, n_dev, scale):
     import jax.numpy as jnp
     from pylops_mpi_tpu.ops import dft
 
-    batch, n = 128 * scale, 1024  # 1024 = 8 × 128: pure GEMM radix path
-    x = (rng.standard_normal((batch, n))
-         + 1j * rng.standard_normal((batch, n))).astype(np.complex64)
-    xd = jnp.asarray(x)
-    flops = 5 * batch * n * np.log2(n)  # FFT-equivalent flop convention
-
-    prev = os.environ.get("PYLOPS_MPI_TPU_FFT_MODE")
+    # two MDC-realistic regimes (round-3 VERDICT next #7): many small
+    # batched transforms (the Fredholm/MDC frequency sweep) and one
+    # long axis (where O(n·base) GEMM-DFT loses hardest to O(n log n))
+    cases = {"batched_small": (128 * scale, 1024),
+             "long_axis": (4, 65536 * scale)}
     out = {}
     try:
-        for mode in ("matmul", "xla"):
-            os.environ["PYLOPS_MPI_TPU_FFT_MODE"] = mode
-            try:
-                fn = jax.jit(lambda v: dft.fft(v, axis=-1))
-                jax.block_until_ready(fn(xd))  # compile + dead-op probe
-                dt = _timeit(fn, xd, inner=10)
-                out[mode] = round(flops / dt / 1e9, 1)
-            except Exception:
-                # e.g. UNIMPLEMENTED fft custom-call; this config runs
-                # isolated on TPU so a wedge cannot poison the rest
-                out[mode] = None
+        for tag, (batch, n) in cases.items():
+            x = (rng.standard_normal((batch, n))
+                 + 1j * rng.standard_normal((batch, n))
+                 ).astype(np.complex64)
+            xd = jnp.asarray(x)
+            flops = 5 * batch * n * np.log2(n)  # FFT flop convention
+            row = {}
+            for mode in ("matmul", "xla"):
+                dft.set_fft_mode(mode)  # env is ignored after first use
+                try:
+                    fn = jax.jit(lambda v: dft.fft(v, axis=-1))
+                    jax.block_until_ready(fn(xd))  # compile + probe
+                    dt = _timeit(fn, xd, inner=10)
+                    row[mode] = round(flops / dt / 1e9, 1)
+                except Exception:
+                    # e.g. UNIMPLEMENTED fft custom-call; this config
+                    # runs isolated on TPU so a wedge cannot poison
+                    # the rest
+                    row[mode] = None
+            if row.get("matmul") and row.get("xla"):
+                row["vs_xla"] = round(row["matmul"] / row["xla"], 2)
+            row["shape"] = f"{batch}x{n}"
+            out[tag] = row
     finally:
-        if prev is None:
-            os.environ.pop("PYLOPS_MPI_TPU_FFT_MODE", None)
-        else:
-            os.environ["PYLOPS_MPI_TPU_FFT_MODE"] = prev
+        dft.set_fft_mode(None)
+    bs = out.get("batched_small", {})
     return {"bench": "dft_engine",
-            "value": out.get("matmul"), "unit": "GFLOP/s (matmul engine)",
-            "xla_gflops": out.get("xla"),
-            "shape": f"{batch}x{n}"}
+            "value": bs.get("matmul"), "unit": "GFLOP/s (matmul engine)",
+            "xla_gflops": bs.get("xla"),
+            "vs_xla": bs.get("vs_xla"),
+            "cases": out,
+            "shape": bs.get("shape")}
 
 
 def _bench_fredholm(pmt, rng, n_dev, scale):
@@ -333,6 +343,49 @@ def _bench_cgls_multirhs(pmt, rng, n_dev, scale):
             "shape": f"{n_dev}x{n}^2,nrhs={nrhs}"}
 
 
+def _bench_precision_pin(pmt, rng, n_dev, scale):
+    """What the package's ``jax_default_matmul_precision=highest`` pin
+    costs (round-3 VERDICT weak #4): one representative f32 GEMM traced
+    under ``highest`` (true f32: 3-pass bf16 decomposition on the MXU)
+    vs ``default`` (1-pass bf16 on TPU, ~1e-3 rel err — the round-3
+    SUMMA hardware failure) vs explicit bf16 inputs (the sanctioned
+    fast path, ``compute_dtype=bfloat16``). Errors are against the f64
+    NumPy product. On CPU the three speeds coincide (the flag is an MXU
+    concern); the rows exist so a TPU window fills them with real
+    ratios for the docs/tpu.md policy table."""
+    import jax
+    import jax.numpy as jnp
+    m = 512 * scale
+    A = rng.standard_normal((m, m)).astype(np.float32)
+    B = rng.standard_normal((m, m)).astype(np.float32)
+    ref = A.astype(np.float64) @ B.astype(np.float64)
+    refn = np.linalg.norm(ref)
+    Ad, Bd = jnp.asarray(A), jnp.asarray(B)
+    flops = 2.0 * m ** 3
+    rows = {}
+    for mode in ("highest", "default"):
+        with jax.default_matmul_precision(mode):
+            fn = jax.jit(lambda a, b: a @ b)
+            dt = _timeit(fn, Ad, Bd, inner=5)
+            y = np.asarray(fn(Ad, Bd), dtype=np.float64)
+        rows[mode] = {"gflops": round(flops / dt / 1e9, 1),
+                      "rel_err": f"{np.linalg.norm(y - ref) / refn:.1e}"}
+    fnb = jax.jit(lambda a, b: (a @ b).astype(jnp.float32))
+    Ab, Bb = Ad.astype(jnp.bfloat16), Bd.astype(jnp.bfloat16)
+    dtb = _timeit(fnb, Ab, Bb, inner=5)
+    yb = np.asarray(fnb(Ab, Bb), dtype=np.float64)
+    rows["bf16_inputs"] = {
+        "gflops": round(flops / dtb / 1e9, 1),
+        "rel_err": f"{np.linalg.norm(yb - ref) / refn:.1e}"}
+    return {"bench": "precision_pin",
+            "value": rows["highest"]["gflops"],
+            "unit": "GFLOP/s (f32 GEMM @ highest)",
+            "modes": rows,
+            "pin_cost_x": round(rows["default"]["gflops"]
+                                / max(rows["highest"]["gflops"], 1e-9), 2),
+            "shape": f"{m}x{m}@{m}x{m}"}
+
+
 _BENCHES = [("first_derivative_halo", _bench_first_derivative),
             ("summa_matmul", _bench_summa),
             ("pencil_fft2d", _bench_fft),
@@ -340,6 +393,7 @@ _BENCHES = [("first_derivative_halo", _bench_first_derivative),
             ("poststack_inversion", _bench_poststack),
             ("mdc_apply", _bench_mdc),
             ("cgls_multirhs", _bench_cgls_multirhs),
+            ("precision_pin", _bench_precision_pin),
             # LAST: its xla-mode probe can wedge an FFT-less runtime's
             # process (benign when isolated; ordering protects the
             # in-process fallback path)

@@ -75,7 +75,7 @@ PAGES = {
           "batched_normal_matvec", "normal_matvec_supported",
           "pallas_available"]),
         ("Local FFT engine", "pylops_mpi_tpu.ops.dft",
-         ["fft", "ifft", "rfft", "irfft", "fft_mode", "use_matmul_fft"]),
+         ["fft", "ifft", "rfft", "irfft", "fft_mode", "set_fft_mode", "use_matmul_fft"]),
     ],
     "utils": [
         ("Testing", "pylops_mpi_tpu.utils.dottest", ["dottest"]),

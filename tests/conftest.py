@@ -13,10 +13,15 @@ sitecustomize may have already overridden the env-level selection.
 
 import os
 
+# Mesh size is env-driven so CI can run the suite at {2, 4, 8} devices
+# plus a ragged-heavy non-power count (5), mirroring the reference's
+# rank matrix (ref .github/workflows/build.yml:15-27). Default stays 8.
+NDEV = int(os.environ.get("PYLOPS_MPI_TPU_TEST_DEVICES", "8"))
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+        flags + f" --xla_force_host_platform_device_count={NDEV}").strip()
 
 import jax
 
@@ -30,3 +35,20 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _reset_fft_mode():
+    """The local-FFT engine mode is cached at first use for determinism
+    (ops/dft.py); tests that monkeypatch PYLOPS_MPI_TPU_FFT_MODE need a
+    fresh resolution each test."""
+    from pylops_mpi_tpu.ops import dft
+    dft._mode_cache = None
+    yield
+    dft._mode_cache = None
+
+
+@pytest.fixture(scope="session")
+def ndev():
+    """Actual device count (== NDEV unless XLA_FLAGS was pre-set)."""
+    return len(jax.devices())
