@@ -652,27 +652,36 @@ def _tpu_probe(timeout: int):
 
 # the rev key must change when CODE changes, not when artifacts do:
 # keying on HEAD would invalidate banked 40-minute stages every time
-# log/cache files (or docs) get committed. Shared with the probe daemon.
-_CODE_PATHS = ["pylops_mpi_tpu", "benchmarks", "bench.py",
-               "__graft_entry__.py"]
+# log/cache files, docs, or regenerated benchmark artifacts (e.g.
+# benchmarks/rehearsal_r04.json) get committed. benchmarks/ holds both
+# code and artifacts, so only its *.py files count. Shared with the
+# probe daemon.
+_CODE_PATHS = ["pylops_mpi_tpu", "bench.py", "__graft_entry__.py",
+               ":(glob)benchmarks/*.py"]
 
 
 def _current_code_rev() -> str:
     try:
+        import hashlib
         root = os.path.dirname(os.path.abspath(__file__))
-        trees = []
-        for p in _CODE_PATHS:
+        h = hashlib.sha256()
+        for p in ("pylops_mpi_tpu", "bench.py", "__graft_entry__.py"):
             r = subprocess.run(["git", "rev-parse", f"HEAD:{p}"],
                                capture_output=True, text=True, cwd=root,
                                timeout=10)
-            trees.append(r.stdout.strip()[:12] if r.returncode == 0
-                         else "none")
+            h.update((r.stdout.strip() if r.returncode == 0
+                      else "none").encode())
+        bl = subprocess.run(
+            ["git", "ls-tree", "HEAD", "benchmarks/"],
+            capture_output=True, text=True, cwd=root, timeout=10).stdout
+        for line in sorted(l for l in bl.splitlines()
+                           if l.endswith(".py")):
+            h.update(line.encode())
         d = subprocess.run(["git", "status", "--porcelain", "--"]
                            + _CODE_PATHS,
                            capture_output=True, text=True, cwd=root,
                            timeout=10).stdout.strip()
-        key = "-".join(t[:7] for t in trees)
-        return key + ("+dirty" if d else "")
+        return h.hexdigest()[:16] + ("+dirty" if d else "")
     except Exception:
         return "unknown"
 
@@ -769,9 +778,15 @@ def _merge_tpu_cache(result, root=None):
                             old_mfu * f32["gflops"] / old_gflops, 4)
                     else:
                         result["mfu"] = None
+                    # REWRITE the label: the old string names bf16's
+                    # mode and rel_err, which no longer describe the
+                    # promoted numbers
+                    base = result.get("metric", "").split("(")[0].strip()
                     result["metric"] = (
-                        result.get("metric", "") +
-                        " [f32 promoted to primary per round-4 policy]")
+                        f"{base} (cached {key}, f32 two-sweep promoted "
+                        f"to primary per round-4 policy"
+                        + (f"; rel_err={f32['rel_err']}"
+                           if f32.get("rel_err") else "") + ")")
                 break
     if "selfcheck" not in result:
         ent = cache.get("selfcheck") or {}

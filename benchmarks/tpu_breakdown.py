@@ -174,6 +174,26 @@ def main() -> None:
         out["roofline_per_iter_ms"] = round(
             bytes_per_iter / (hbm_gbps * 1e9) * 1e3, 4)
 
+    # 6. bank a raw profiler trace of ~20 fused iterations for offline
+    # analysis (the tunnel backend may not support tracing — recorded
+    # either way; parsing needs tensorboard tooling this host lacks)
+    if os.environ.get("BREAKDOWN_TRACE", "1") != "0":
+        # per-run subdir: a silent empty trace must not inherit an
+        # earlier run's files as evidence
+        trace_dir = os.path.join(
+            _HERE, ".profile_r04",
+            time.strftime("%Y%m%dT%H%M%S") + f"-{os.getpid()}")
+        try:
+            fn20 = jax.jit(lambda y, x: _cgls_fused(Op, y, x, 20,
+                                                    0.0, 0.0)[0]._arr)
+            jax.block_until_ready(fn20(dy, x0))  # compile outside trace
+            with jax.profiler.trace(trace_dir):
+                jax.block_until_ready(fn20(dy, x0))
+            n_files = sum(len(fs) for _, _, fs in os.walk(trace_dir))
+            out["profile_trace"] = {"dir": trace_dir, "files": n_files}
+        except Exception as e:
+            out["profile_trace"] = {"error": repr(e)[:200]}
+
     print(json.dumps(out))
 
 

@@ -72,10 +72,12 @@ def _fftless_runtime() -> bool:
     """True when the active JAX platform list names a runtime known to
     ship no fft custom-call. Reading ``jax_platforms`` config does not
     initialize any backend (critical: the tunnel's init can hang)."""
-    known = os.environ.get("PYLOPS_MPI_TPU_FFTLESS_RUNTIMES", "axon")
-    platforms = str(jax.config.jax_platforms or "").lower()
-    return any(k.strip() and k.strip() in platforms.split(",")
-               for k in known.lower().split(","))
+    known = {k.strip() for k in os.environ.get(
+        "PYLOPS_MPI_TPU_FFTLESS_RUNTIMES", "axon").lower().split(",")
+        if k.strip()}
+    platforms = {t.strip() for t in
+                 str(jax.config.jax_platforms or "").lower().split(",")}
+    return bool(known & platforms)
 
 
 def fft_mode() -> str:

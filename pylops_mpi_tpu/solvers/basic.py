@@ -45,6 +45,23 @@ def _abs(v):
     return jnp.abs(jnp.asarray(v))
 
 
+def _mp_floor(k0):
+    """Machine-precision floor for the squared gradient norm: once
+    ``k = |Aᴴr|²`` falls below ``(100·eps)²·k0`` further updates are
+    numerical noise. The fused loops FREEZE the recurrence there (zero
+    step + zero momentum) instead of exiting: iterating past this point
+    is not just useless, it is unstable — the ``k/kold`` ratio of
+    noise-level quantities can drift above 1 and pump the recurrence
+    exponentially (observed: a 5-shard ragged CGLS at tol=0 reached
+    1e13 error by iteration 400 while NumPy's trajectory happened to
+    hit an exact fixed point). Freezing (rather than early exit) keeps
+    the iteration count — and the per-iteration work the benchmarks
+    time — exactly as requested."""
+    k0 = jnp.asarray(k0)
+    eps = jnp.finfo(k0.dtype).eps
+    return k0 * (100 * eps) ** 2
+
+
 class _BaseSolver:
     def __init__(self, Op):
         self.Op = Op
@@ -230,12 +247,15 @@ def _cg_fused(Op, y: Vector, x0: Vector, niter: int, tol):
 
     def body(state):
         x, r, c, kold, iiter, cost = state
+        done = kold <= floors
         Opc = Op.matvec(c)
         a = kold / _abs(c.dot(Opc.conj()))
+        a = jnp.where(done, jnp.zeros_like(a), a)
         x = x + c * a
         r = r - Opc * a
         k = _abs(r.dot(r.conj()))
-        c = r + c * (k / kold)
+        k = jnp.where(done, kold, k)
+        c = r + c * jnp.where(done, jnp.zeros_like(k), k / kold)
         iiter = iiter + 1
         cost = lax.dynamic_update_index_in_dim(cost, jnp.sqrt(k), iiter, 0)
         return (x, r, c, k, iiter, cost)
@@ -248,6 +268,7 @@ def _cg_fused(Op, y: Vector, x0: Vector, niter: int, tol):
     r = y - Op.matvec(x)
     c = r.copy()
     kold = _abs(r.dot(r.conj()))
+    floors = _mp_floor(kold)
     cost0 = jnp.zeros((niter + 1,) + jnp.shape(kold), dtype=jnp.asarray(kold).dtype)
     cost0 = lax.dynamic_update_index_in_dim(cost0, jnp.sqrt(kold), 0, 0)
     state = (x, r, c, kold, jnp.asarray(0), cost0)
@@ -260,12 +281,15 @@ def _cgls_fused(Op, y: Vector, x0: Vector, niter: int, damp, tol):
 
     def body(state):
         x, s, c, q, kold, iiter, cost, cost1 = state
+        done = kold <= floors
         a = _abs(kold / (q.dot(q.conj()) + damp2 * c.dot(c.conj())))
+        a = jnp.where(done, jnp.zeros_like(a), a)
         x = x + c * a
         s = s - q * a
         r = Op.rmatvec(s) - x * damp2
         k = _abs(r.dot(r.conj()))
-        c = r + c * (k / kold)
+        k = jnp.where(done, kold, k)
+        c = r + c * jnp.where(done, jnp.zeros_like(k), k / kold)
         q = Op.matvec(c)
         iiter = iiter + 1
         sn = jnp.asarray(s.norm())
@@ -283,6 +307,7 @@ def _cgls_fused(Op, y: Vector, x0: Vector, niter: int, damp, tol):
     c = r.copy()
     q = Op.matvec(c)
     kold = _abs(r.dot(r.conj()))
+    floors = _mp_floor(kold)
     sn0 = jnp.asarray(s.norm())
     cost0 = jnp.zeros((niter + 1,) + jnp.shape(sn0), dtype=sn0.dtype)
     cost0 = lax.dynamic_update_index_in_dim(cost0, sn0, 0, 0)
@@ -306,13 +331,16 @@ def _cgls_fused_normal(Op, y: Vector, x0: Vector, niter: int, damp, tol):
 
     def body(state):
         x, s, r, c, kold, iiter, cost, cost1 = state
+        done = kold <= floors
         u, q = Op.normal_matvec(c)
         a = _abs(kold / (q.dot(q.conj()) + damp2 * c.dot(c.conj())))
+        a = jnp.where(done, jnp.zeros_like(a), a)
         x = x + c * a
         s = s - q * a
         r = r - (u + c * damp2) * a
         k = _abs(r.dot(r.conj()))
-        c = r + c * (k / kold)
+        k = jnp.where(done, kold, k)
+        c = r + c * jnp.where(done, jnp.zeros_like(k), k / kold)
         iiter = iiter + 1
         sn = jnp.asarray(s.norm())
         cost = lax.dynamic_update_index_in_dim(cost, sn, iiter, 0)
@@ -328,6 +356,7 @@ def _cgls_fused_normal(Op, y: Vector, x0: Vector, niter: int, damp, tol):
     rq = Op.rmatvec(s) - x * damp  # ref's un-squared setup damp (see
     c = rq.copy()                  # module doc) seeds only the first
     kold = _abs(rq.dot(rq.conj()))  # direction, as in the classic path
+    floors = _mp_floor(kold)
     # the recurrence tracks the true gradient r = Opᴴs − damp²x, so it
     # must start from the damp²-form, not the quirked one
     r = rq + x * (damp - damp2)

@@ -223,7 +223,9 @@ def test_cached_bf16_primary_reranked_to_f32(bench, tmp_path):
     # mfu rescaled by f32/bf16 gflops ratio: 0.02 * 5.6/3.2 = 0.035
     assert abs(merged["mfu"] - 0.035) < 1e-9
     assert merged["bf16"]["iters_per_sec"] == 772.0
-    assert "f32 promoted" in merged["metric"]
+    assert "promoted to primary" in merged["metric"]
+    assert "bf16" not in merged["metric"]  # label rewritten
+    assert "rel_err=1e-06" in merged["metric"]
 
 
 def test_rehearse_never_overwrites_tpu_cache(tmp_path, monkeypatch):

@@ -3,11 +3,16 @@ reference's ``tests/test_blockdiag.py`` and ``tests/test_stack.py``:
 distributed result gathered and compared against the dense serial
 computation."""
 
+import jax
 import numpy as np
 import pytest
 from pylops_mpi_tpu import (DistributedArray, Partition, MPIBlockDiag,
                             MPIVStack, MPIHStack, dottest)
 from pylops_mpi_tpu.ops.local import MatrixMult, FirstDerivative
+
+# the batched fast paths require nblocks % P == 0 (ops/blockdiag.py
+# _try_batch) — block counts below scale with the device count
+P = len(jax.devices())
 
 
 def _dense_blockdiag(mats):
@@ -114,12 +119,12 @@ def test_vstack_batched_engages_and_matches_loop(rng):
     """Round-2 VERDICT weak #4: homogeneous MatrixMult rows must
     collapse into one batched GEMM (trace O(1)); heterogeneous rows
     keep the per-op chain with identical values."""
-    mats = [rng.standard_normal((4, 10)) for _ in range(16)]
+    mats = [rng.standard_normal((4, 10)) for _ in range(2 * P)]
     Op = MPIVStack([MatrixMult(m, dtype=np.float64) for m in mats])
     assert Op._batched is not None and Op._batched_adj is False
     dense = np.vstack(mats)
     x = rng.standard_normal(10)
-    y = rng.standard_normal(64)
+    y = rng.standard_normal(8 * P)
     dx = DistributedArray.to_dist(x, partition=Partition.BROADCAST)
     dy = DistributedArray.to_dist(y, local_shapes=Op.local_shapes_n)
     np.testing.assert_allclose(Op.matvec(dx).asarray(), dense @ x,
@@ -134,18 +139,18 @@ def test_vstack_batched_engages_and_matches_loop(rng):
                                rtol=1e-10)
     # heterogeneous shapes refuse to batch
     hetero = MPIVStack([MatrixMult(rng.standard_normal((3 + i % 2, 10)),
-                                   dtype=np.float64) for i in range(16)])
+                                   dtype=np.float64) for i in range(2 * P)])
     assert hetero._batched is None
 
 
 def test_hstack_batched_adjoint_unwrap(rng):
     """MPIHStack builds a VStack of MatrixMult.H rows — the batcher
     must unwrap the adjoint wrappers and stay one GEMM."""
-    mats = [rng.standard_normal((10, 4)) for _ in range(8)]
+    mats = [rng.standard_normal((10, 4)) for _ in range(P)]
     Op = MPIHStack([MatrixMult(m, dtype=np.float64) for m in mats])
     assert Op.vstack._batched is not None and Op.vstack._batched_adj is True
     dense = np.hstack(mats)
-    x = rng.standard_normal(32)
+    x = rng.standard_normal(4 * P)
     dx = DistributedArray.to_dist(x)
     np.testing.assert_allclose(Op.matvec(dx).asarray(), dense @ x,
                                rtol=1e-10)
@@ -161,7 +166,7 @@ def test_vstack_trace_size_one_gemm(rng):
     (ref VStack.py:123-150 loops per op on every rank)."""
     import jax
     mats = [rng.standard_normal((4, 12)).astype(np.float32)
-            for _ in range(64)]
+            for _ in range(8 * P)]
     Op = MPIVStack([MatrixMult(m, dtype=np.float32) for m in mats])
     assert Op._batched is not None
     dx = DistributedArray.to_dist(rng.standard_normal(12).astype(np.float32),
@@ -176,11 +181,14 @@ def test_vstack_trace_size_one_gemm(rng):
 def test_blockdiag_masked(rng):
     """mask splits shards into independent groups
     (ref BlockDiag.py mask support)."""
-    mask = [0, 0, 0, 0, 1, 1, 1, 1]
-    mats = [rng.standard_normal((4, 4)) for _ in range(8)]
+    import jax
+    P = len(jax.devices())
+    half = P // 2 or 1
+    mask = [i // half for i in range(P)]
+    mats = [rng.standard_normal((4, 4)) for _ in range(P)]
     Op = MPIBlockDiag([MatrixMult(m, dtype=np.float64) for m in mats],
                       mask=mask)
-    x = rng.standard_normal(32)
+    x = rng.standard_normal(4 * P)
     dx = DistributedArray.to_dist(x, mask=mask)
     y = Op.matvec(dx)
     assert y.mask == tuple(mask)
@@ -192,9 +200,9 @@ def test_blockdiag_batched_vs_chunked_paths(rng):
     """Homogeneous MatrixMult blocks ride the stacked batched-GEMM fast
     path; forcing heterogeneity falls back to per-block chunks — both
     must agree with the dense oracle (ref BlockDiag.py:106-132)."""
-    mats = [rng.standard_normal((4, 4)) for _ in range(8)]
+    mats = [rng.standard_normal((4, 4)) for _ in range(P)]
     dense = _dense_blockdiag(mats)
-    x = rng.standard_normal(32)
+    x = rng.standard_normal(4 * P)
     dx = DistributedArray.to_dist(x)
     homo = MPIBlockDiag([MatrixMult(m, dtype=np.float64) for m in mats])
     assert homo._batched is not None
@@ -207,7 +215,8 @@ def test_blockdiag_batched_vs_chunked_paths(rng):
                           + [Diagonal(np.diag(mats[-1]), dtype=np.float64)])
     assert hetero._batched is None
     dd = dense.copy()
-    dd[28:, 28:] = np.diag(np.diag(mats[-1]))
+    off = 4 * (P - 1)
+    dd[off:, off:] = np.diag(np.diag(mats[-1]))
     np.testing.assert_allclose(hetero.matvec(dx).asarray(), dd @ x,
                                rtol=1e-12)
 
@@ -272,7 +281,7 @@ def test_blockdiag_multirhs_batched(rng):
     GEMM fast path — the GEMV->GEMM lever — with values equal to the
     per-op loop."""
     k = 3
-    mats = [rng.standard_normal((5, 4)) for _ in range(8)]
+    mats = [rng.standard_normal((5, 4)) for _ in range(P)]
     Op = MPIBlockDiag([MatrixMult(m, otherdims=(k,), dtype=np.float64)
                        for m in mats])
     assert Op._batched is not None and Op._batched_k == k
@@ -302,7 +311,7 @@ def test_vstack_compute_dtype_bf16(rng):
     accumulation (mirrors the MPIBlockDiag lever)."""
     import jax.numpy as jnp
     mats = [rng.standard_normal((4, 12)).astype(np.float32)
-            for _ in range(8)]
+            for _ in range(P)]
     Op32 = MPIVStack([MatrixMult(m, dtype=np.float32) for m in mats])
     Opbf = MPIVStack([MatrixMult(m, dtype=np.float32) for m in mats],
                      compute_dtype=jnp.bfloat16)
@@ -316,7 +325,7 @@ def test_vstack_compute_dtype_bf16(rng):
         / np.linalg.norm(y32.asarray())
     assert 0 < rel < 2e-2
     dy = DistributedArray.to_dist(
-        rng.standard_normal(32).astype(np.float32),
+        rng.standard_normal(4 * P).astype(np.float32),
         local_shapes=Op32.local_shapes_n)
     abf = Opbf.rmatvec(dy)
     assert abf.dtype == np.float32
@@ -332,12 +341,12 @@ def test_hstack_compute_dtype_and_complex_guard(rng):
     import jax.numpy as jnp
     import pytest as _pytest
     mats = [rng.standard_normal((12, 4)).astype(np.float32)
-            for _ in range(8)]
+            for _ in range(P)]
     Op32 = MPIHStack([MatrixMult(m, dtype=np.float32) for m in mats])
     Opbf = MPIHStack([MatrixMult(m, dtype=np.float32) for m in mats],
                      compute_dtype=jnp.bfloat16)
     assert Opbf.vstack._batched_adj is True
-    x = rng.standard_normal(32).astype(np.float32)
+    x = rng.standard_normal(4 * P).astype(np.float32)
     dx = DistributedArray.to_dist(x)
     ybf = Opbf.matvec(dx)
     assert ybf.dtype == np.float32

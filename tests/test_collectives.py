@@ -48,12 +48,13 @@ def _run_ring(mesh, x, front, back):
 def test_ring_halo_extend(mesh, rng):
     """Each shard's block is extended with the predecessor's last row
     and the successor's first row; zeros at the domain edges."""
-    x = jnp.asarray(rng.standard_normal((16, 3)))
-    got = _run_ring(mesh, x, 1, 1).reshape(8, 4, 3)
-    xv = np.asarray(x).reshape(8, 2, 3)
-    for i in range(8):
+    P = int(mesh.devices.size)
+    x = jnp.asarray(rng.standard_normal((2 * P, 3)))
+    got = _run_ring(mesh, x, 1, 1).reshape(P, 4, 3)
+    xv = np.asarray(x).reshape(P, 2, 3)
+    for i in range(P):
         exp_front = np.zeros(3) if i == 0 else xv[i - 1, -1]
-        exp_back = np.zeros(3) if i == 7 else xv[i + 1, 0]
+        exp_back = np.zeros(3) if i == P - 1 else xv[i + 1, 0]
         np.testing.assert_allclose(got[i, 0], exp_front)
         np.testing.assert_allclose(got[i, 1:3], xv[i])
         np.testing.assert_allclose(got[i, 3], exp_back)
@@ -62,10 +63,11 @@ def test_ring_halo_extend(mesh, rng):
 def test_ring_halo_extend_stencil(mesh, rng):
     """Ghosted blocks reproduce the global centered stencil on interior
     rows."""
-    x = jnp.asarray(rng.standard_normal(32))
-    got = _run_ring(mesh, x, 1, 1).reshape(8, 6)
+    P = int(mesh.devices.size)
+    x = jnp.asarray(rng.standard_normal(4 * P))
+    got = _run_ring(mesh, x, 1, 1).reshape(P, 6)
     mid = (got[:, 2:] - got[:, :-2]) / 2
-    expected = np.zeros(32)
+    expected = np.zeros(4 * P)
     expected[1:-1] = (np.asarray(x)[2:] - np.asarray(x)[:-2]) / 2
     np.testing.assert_allclose(mid.ravel()[1:-1], expected[1:-1],
                                rtol=1e-12)

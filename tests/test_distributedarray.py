@@ -2,6 +2,7 @@
 the reference (oracle pattern: distributed result gathered and compared
 against plain NumPy)."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -80,38 +81,49 @@ def test_norm_axis(rng):
                                np.linalg.norm(x, axis=1), rtol=1e-12)
 
 
+P = len(jax.devices())
+
+
+def _mask_groups(ngroups):
+    """Contiguous coloring of the P shards into min(ngroups, P) groups
+    (the P-general form of the old hardcoded 8-shard masks)."""
+    g = min(ngroups, P)
+    size = P // g or 1
+    mask = [min(i // size, g - 1) for i in range(P)]
+    return mask, g
+
+
 def test_masked_dot(rng):
     """Sub-communicator groups: dot reduces within each color group
     (ref DistributedArray.py:74-100)."""
-    n_shards = 8
-    mask = [0, 0, 1, 1, 2, 2, 3, 3]
-    x = rng.standard_normal(32)
-    y = rng.standard_normal(32)
+    mask, ng = _mask_groups(4)
+    x = rng.standard_normal(4 * P)
+    y = rng.standard_normal(4 * P)
     dx = DistributedArray.to_dist(x, mask=mask)
     dy = DistributedArray.to_dist(y, mask=mask)
     got = np.asarray(dx.dot(dy))
-    assert got.shape == (4,)
+    assert got.shape == (ng,)
     # oracle: group-local dot over each group's contiguous index range
     sizes = [s[0] for s in dx.local_shapes]
     offs = np.concatenate([[0], np.cumsum(sizes)])
-    for g in range(4):
+    for g in range(ng):
         idx = np.concatenate([np.arange(offs[i], offs[i + 1])
-                              for i in range(n_shards) if mask[i] == g])
+                              for i in range(P) if mask[i] == g])
         np.testing.assert_allclose(got[g], np.dot(x[idx], y[idx]), rtol=1e-12)
 
 
 @pytest.mark.parametrize("ord", [0, 1, 2, np.inf, -np.inf])
 def test_masked_norm(rng, ord):
-    mask = [0, 0, 0, 0, 1, 1, 1, 1]
-    x = rng.standard_normal(24)
+    mask, ng = _mask_groups(2)
+    x = rng.standard_normal(3 * P)
     dx = DistributedArray.to_dist(x, mask=mask)
     got = np.asarray(dx.norm(ord))
-    assert got.shape == (2,)
+    assert got.shape == (ng,)
     sizes = [s[0] for s in dx.local_shapes]
     offs = np.concatenate([[0], np.cumsum(sizes)])
-    for g in range(2):
+    for g in range(ng):
         idx = np.concatenate([np.arange(offs[i], offs[i + 1])
-                              for i in range(8) if mask[i] == g])
+                              for i in range(P) if mask[i] == g])
         np.testing.assert_allclose(got[g], np.linalg.norm(x[idx], ord=ord),
                                    rtol=1e-12)
 
@@ -119,16 +131,16 @@ def test_masked_norm(rng, ord):
 def test_group_scalar_arithmetic(rng):
     """Per-group scalars from a masked dot broadcast back onto the array,
     the one-controller analog of each rank using its group's scalar."""
-    mask = [0, 0, 0, 0, 1, 1, 1, 1]
-    x = rng.standard_normal(16)
+    mask, ng = _mask_groups(2)
+    x = rng.standard_normal(2 * P)
     dx = DistributedArray.to_dist(x, mask=mask)
-    s = dx.dot(dx)  # (2,)
+    s = dx.dot(dx)  # (ng,)
     y = dx * s
     sizes = [sh[0] for sh in dx.local_shapes]
     offs = np.concatenate([[0], np.cumsum(sizes)])
     expected = x.copy()
     sn = np.asarray(s)
-    for i in range(8):
+    for i in range(P):
         expected[offs[i]:offs[i + 1]] *= sn[mask[i]]
     np.testing.assert_allclose(y.asarray(), expected, rtol=1e-12)
 
@@ -287,7 +299,8 @@ def test_add_ghost_cells_widths(rng):
 
 
 def test_add_ghost_cells_too_wide(rng):
-    dx = DistributedArray.to_dist(rng.standard_normal(16))  # 2 rows/shard
+    # 2 rows/shard at any device count
+    dx = DistributedArray.to_dist(rng.standard_normal(2 * P))
     with pytest.raises(ValueError, match="ghost"):
         dx.add_ghost_cells(cells_front=3)
 
@@ -311,23 +324,27 @@ def test_ghosted_hlo_is_ring_exchange(rng):
 def test_ghosted_ragged_matches_gather_oracle(rng):
     """Ragged (pad-to-max) splits: the ring-exchange ghosts must equal
     the reference windows built from the logical global array."""
-    x = rng.standard_normal((19, 3))  # 19 over 8 shards: sizes 3,...,2
+    n = 3 * P - 1  # ragged over P shards (P-1 shards of 3, one of 2)
+    x = rng.standard_normal((n, 3))
     dx = DistributedArray.to_dist(x, axis=0)
     sizes = [s[0] for s in dx.local_shapes]
+    assert len(set(sizes)) > 1  # really ragged
     offs = np.concatenate([[0], np.cumsum(sizes)])
     for front, back in ((1, 1), (2, 2), (0, 2), (2, 0)):
         g = dx.ghosted(cells_front=front, cells_back=back)
         blocks = g.local_arrays()
         for i, blk in enumerate(blocks):
             lo = max(0, offs[i] - (front if i > 0 else 0))
-            hi = min(19, offs[i + 1] + (back if i < 7 else 0))
+            hi = min(n, offs[i + 1] + (back if i < P - 1 else 0))
             np.testing.assert_allclose(np.asarray(blk), x[lo:hi],
                                        rtol=1e-14)
         # the ghosted object is itself a consistent SCATTER array
         np.testing.assert_allclose(
-            g.asarray(), np.concatenate([x[max(0, offs[i] - (front if i else 0)):
-                                           min(19, offs[i + 1] + (back if i < 7 else 0))]
-                                         for i in range(8)]), rtol=1e-14)
+            g.asarray(),
+            np.concatenate([x[max(0, offs[i] - (front if i else 0)):
+                              min(n, offs[i + 1]
+                                  + (back if i < P - 1 else 0))]
+                            for i in range(P)]), rtol=1e-14)
 
 
 def test_to_partition_roundtrip(rng):
@@ -382,31 +399,36 @@ def test_global_shape_mismatch_raises(rng):
 
 def test_custom_local_shapes_validation(rng):
     with pytest.raises(ValueError, match="sum to"):
-        DistributedArray((16,), local_shapes=[(3,)] * 8)  # 24 != 16
+        # P shapes (right count), wrong total
+        DistributedArray((2 * P,), local_shapes=[(3,)] * P)
     with pytest.raises(ValueError, match="local shapes"):
-        DistributedArray((16,), local_shapes=[(4,)] * 4)  # wrong count
+        DistributedArray((2 * P,), local_shapes=[(2,)] * (P + 1))
 
 
 def test_masked_norm_ords(rng):
     """Per-group norms for every order (ref subcomm reductions)."""
-    mask = [0, 0, 1, 1, 2, 2, 3, 3]
-    x = rng.standard_normal(32)
+    mask, ng = _mask_groups(4)
+    x = rng.standard_normal(4 * P)
     dx = DistributedArray.to_dist(x, mask=mask)
+    sizes = [sh[0] for sh in dx.local_shapes]
+    offs = np.concatenate([[0], np.cumsum(sizes)])
+    gidx = [np.concatenate([np.arange(offs[i], offs[i + 1])
+                            for i in range(P) if mask[i] == g])
+            for g in range(ng)]
     for ordd in (1, 2, np.inf):
         got = np.asarray(dx.norm(ordd))
-        expected = [np.linalg.norm(x[i * 8:(i + 1) * 8], ordd)
-                    for i in range(4)]
+        expected = [np.linalg.norm(x[gi], ordd) for gi in gidx]
         np.testing.assert_allclose(got, expected, rtol=1e-10)
 
 
 def test_ravel_axis1(rng):
     """Shard-major ravel of an axis-1-sharded array is the shard-block
     concatenation, not the global C-ravel (ref DistributedArray.py:847-875)."""
-    x = rng.standard_normal((4, 16))
+    x = rng.standard_normal((4, 2 * P))
     dx = DistributedArray.to_dist(x, axis=1)
     flat = dx.ravel()
     expected = np.concatenate(
-        [x[:, 2 * i:2 * (i + 1)].ravel() for i in range(8)])
+        [x[:, 2 * i:2 * (i + 1)].ravel() for i in range(P)])
     np.testing.assert_allclose(flat.asarray(), expected, rtol=1e-14)
 
 
@@ -451,7 +473,7 @@ def test_local_arrays_scatter(rng):
     locs = dx.local_arrays()
     sizes = [s[0] for s in dx.local_shapes]
     offs = np.concatenate([[0], np.cumsum(sizes)])
-    assert len(locs) == 8
+    assert len(locs) == P
     for i, l in enumerate(locs):
         np.testing.assert_allclose(l, x[offs[i]:offs[i + 1]], rtol=1e-14)
 
@@ -479,9 +501,8 @@ def test_unsafe_broadcast_equivalence(rng):
 
 def test_to_dist_uneven_axis1(rng):
     """Custom ragged local shapes on a non-leading axis."""
-    x = rng.standard_normal((3, 11))
-    shapes = [(3, 3), (3, 2), (3, 1), (3, 1), (3, 1), (3, 1), (3, 1),
-              (3, 1)]
+    x = rng.standard_normal((3, P + 3))
+    shapes = [(3, 3), (3, 2)] + [(3, 1)] * (P - 2)
     dx = DistributedArray.to_dist(x, axis=1, local_shapes=shapes)
     np.testing.assert_allclose(dx.asarray(), x, rtol=1e-14)
     assert dx.local_shapes == tuple(shapes)
@@ -490,8 +511,8 @@ def test_to_dist_uneven_axis1(rng):
 
 
 def test_masked_redistribute_keeps_mask(rng):
-    mask = [0, 0, 0, 0, 1, 1, 1, 1]
-    x = rng.standard_normal((8, 6))
+    mask, _ = _mask_groups(2)
+    x = rng.standard_normal((P, 6))
     dx = DistributedArray.to_dist(x, axis=0, mask=mask)
     dy = dx.redistribute(1)
     assert dy.mask == tuple(mask)

@@ -178,9 +178,11 @@ def test_fredholm1_scatter_zero_comm(rng):
     with G's frequency sharding — identical numbers to the BROADCAST
     path and a compiled program with ZERO collectives (each device
     contracts its own slice batch; 1/P the replicated-model memory)."""
+    import jax
     from pylops_mpi_tpu import Partition
     from pylops_mpi_tpu.utils import collective_report
-    nsl, nx, ny, nz = 16, 6, 5, 3
+    # the zero-comm SCATTER path exists iff nsl %% n_devices == 0
+    nsl, nx, ny, nz = 2 * len(jax.devices()), 6, 5, 3
     G = rng.standard_normal((nsl, nx, ny))
     Fr = MPIFredholm1(G, nz=nz, dtype=np.float64)
     m_np = rng.standard_normal(nsl * ny * nz)
@@ -212,22 +214,26 @@ def test_fredholm1_scatter_zero_comm(rng):
 def test_fredholm1_scatter_misaligned_raises(rng):
     """SCATTER vectors whose shards are not slice-aligned are rejected
     with guidance (silent wrong slicing would be worse)."""
-    G = rng.standard_normal((16, 4, 3))
+    import jax
+    P = len(jax.devices())
+    G = rng.standard_normal((2 * P, 4, 3))
     Fr = MPIFredholm1(G, nz=1, dtype=np.float64)
-    # the default balanced split of 48 over 8 devices would be
-    # slice-aligned here (6 == 2 slices x 3); use a deliberately
-    # misaligned ragged split
-    sizes = [7, 7, 7, 7, 5, 5, 5, 5]
-    bad = DistributedArray.to_dist(rng.standard_normal(48),
-                                   local_shapes=[(s,) for s in sizes])
+    # a deliberately misaligned ragged split: off-by-one sizes on the
+    # first/last shards break slice alignment at any device count
+    n = Fr.shape[1]
+    sizes = [n // P + (1 if i == 0 else 0) - (1 if i == P - 1 else 0)
+             for i in range(P)]
+    bad = DistributedArray.to_dist(rng.standard_normal(n),
+                                   local_shapes=[(sz,) for sz in sizes])
     with pytest.raises(ValueError, match="slice-aligned"):
         Fr.matvec(bad)
-    # non-divisible slice count: no scatter layout exists
-    G2 = rng.standard_normal((6, 4, 3))
+    # non-divisible slice count (2P+1 slices over P): no scatter layout
+    G2 = rng.standard_normal((2 * P + 1, 4, 3))
     Fr2 = MPIFredholm1(G2, nz=1, dtype=np.float64)
     assert Fr2.model_local_shapes is None
     with pytest.raises(ValueError, match="slice-aligned"):
-        Fr2.matvec(DistributedArray.to_dist(rng.standard_normal(18)))
+        Fr2.matvec(DistributedArray.to_dist(
+            rng.standard_normal(Fr2.shape[1])))
 
 
 def test_fredholm_compute_dtype_c64(rng):

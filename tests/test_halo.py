@@ -2,6 +2,7 @@
 reference's halo/nonstatconv tests: distributed sandwich vs serial
 global operator."""
 
+import jax
 import numpy as np
 import pytest
 import jax.numpy as jnp
@@ -10,6 +11,22 @@ from pylops_mpi_tpu import (DistributedArray, Partition, MPIHalo,
                             MPIBlockDiag, MPINonStationaryConvolve1D,
                             halo_block_split)
 from pylops_mpi_tpu.ops.local import NonStationaryConvolve1D, Conv1D
+
+P = len(jax.devices())  # suite runs at {2,4,5,8} (conftest NDEV)
+
+
+def _grid2(p):
+    """A 2-D process grid with product p, preferring 2 rows."""
+    return (2, p // 2) if p % 2 == 0 else (1, p)
+
+
+def _grid3(p):
+    """A 3-D process grid with product p (trailing 1s when prime)."""
+    if p % 4 == 0:
+        return (2, 2, p // 4)
+    if p % 2 == 0:
+        return (2, p // 2, 1)
+    return (p, 1, 1)
 
 
 def _block_flat(x_nd, grid):
@@ -35,18 +52,17 @@ def test_halo_block_split():
 @pytest.mark.parametrize("halo", [1, 2])
 def test_halo_1d_scalar(rng, halo):
     """Scalar halo is trimmed at grid boundaries (ref Halo.py:204-210)."""
-    n = 24
+    n = 3 * P
     x = rng.standard_normal(n)
     Hop = MPIHalo(dims=n, halo=halo, dtype=np.float64)
     dx = DistributedArray.to_dist(x)  # even split == block split for 1-D
     y = Hop.matvec(dx)
     # oracle: each block extended with neighbour rows, one-sided at edges
-    sizes = [3 if i in (0, 7) else 3 + (0 if halo == 0 else 0) for i in range(8)]
     locs = y.local_arrays()
     offs = np.arange(0, n + 1, 3)
-    for i in range(8):
+    for i in range(P):
         lo = max(0, offs[i] - (halo if i > 0 else 0))
-        hi = min(n, offs[i + 1] + (halo if i < 7 else 0))
+        hi = min(n, offs[i + 1] + (halo if i < P - 1 else 0))
         np.testing.assert_allclose(locs[i], x[lo:hi])
     # adjoint crops back
     z = Hop.rmatvec(y)
@@ -55,33 +71,34 @@ def test_halo_1d_scalar(rng, halo):
 
 def test_halo_1d_tuple_zero_boundary(rng):
     """Tuple halo keeps boundary zones, zero-filled (ref Halo.py:216-227)."""
-    n = 16
+    n = 2 * P
     x = rng.standard_normal(n)
     Hop = MPIHalo(dims=n, halo=(1,), dtype=np.float64)
     dx = DistributedArray.to_dist(x)
     locs = Hop.matvec(dx).local_arrays()
     np.testing.assert_allclose(locs[0], np.concatenate([[0], x[:3]]))
-    np.testing.assert_allclose(locs[7], np.concatenate([x[13:], [0]]))
+    np.testing.assert_allclose(locs[P - 1],
+                               np.concatenate([x[n - 3:], [0]]))
 
 
 def test_halo_2d_grid(rng):
     """2-D Cartesian grid with diagonal corners (the relay pattern of
     ref Halo.py:320-360)."""
-    dims = (8, 8)
-    grid = (2, 4)
+    grid = _grid2(P)
+    dims = (4 * grid[0], 2 * grid[1])
     x = rng.standard_normal(dims)
     flat, sizes = _block_flat(x, grid)
     Hop = MPIHalo(dims=dims, halo=1, proc_grid_shape=grid, dtype=np.float64)
     dx = DistributedArray.to_dist(flat, local_shapes=sizes)
     y = Hop.matvec(dx)
     locs = y.local_arrays()
-    for r in range(8):
+    for r in range(P):
         sl = halo_block_split(dims, r, grid)
         i, j = np.unravel_index(r, grid)
         lo0 = sl[0].start - (1 if i > 0 else 0)
-        hi0 = sl[0].stop + (1 if i < 1 else 0)
+        hi0 = sl[0].stop + (1 if i < grid[0] - 1 else 0)
         lo1 = sl[1].start - (1 if j > 0 else 0)
-        hi1 = sl[1].stop + (1 if j < 3 else 0)
+        hi1 = sl[1].stop + (1 if j < grid[1] - 1 else 0)
         expected = x[lo0:hi0, lo1:hi1]
         np.testing.assert_allclose(locs[r].reshape(expected.shape), expected)
     z = Hop.rmatvec(y)
@@ -91,7 +108,7 @@ def test_halo_2d_grid(rng):
 def test_halo_sandwich_conv(rng):
     """The design use: HOp.H @ BlockDiag(local conv) @ HOp equals the
     global convolution (ref NonStatConvolve1d.py:139-188 idiom)."""
-    n = 32
+    n = 4 * P
     h = rng.standard_normal(5)
     x = rng.standard_normal(n)
     Hop = MPIHalo(dims=n, halo=2, dtype=np.float64)
@@ -111,7 +128,7 @@ def test_halo_hlo_is_neighbor_exchange(rng):
     failure mode: global gather + re-slice)."""
     import jax
 
-    n = 32
+    n = 4 * P
     Hop = MPIHalo(dims=n, halo=1, dtype=np.float64)
     dx = DistributedArray.to_dist(rng.standard_normal(n))
     fn = jax.jit(lambda d: Hop.matvec(d)._arr)
@@ -120,7 +137,8 @@ def test_halo_hlo_is_neighbor_exchange(rng):
     assert "all-gather" not in txt and "all_gather" not in txt
 
     # 2-D grid matvec+adjoint roundtrip: still permute-only
-    dims, grid = (8, 8), (2, 4)
+    grid = _grid2(P)
+    dims = (4 * grid[0], 2 * grid[1])
     x2 = rng.standard_normal(dims)
     flat, sizes = _block_flat(x2, grid)
     Hop2 = MPIHalo(dims=dims, halo=1, proc_grid_shape=grid,
@@ -134,7 +152,8 @@ def test_halo_hlo_is_neighbor_exchange(rng):
 
 def test_halo_validates_width():
     with pytest.raises(ValueError, match="halo width exceeds"):
-        MPIHalo(dims=16, halo=3, dtype=np.float64)  # blocks of 2 < halo 3
+        # blocks of 2 < halo 3, at any device count
+        MPIHalo(dims=2 * P, halo=3, dtype=np.float64)
 
 
 def test_local_nonstatconv_oracle(rng):
@@ -165,10 +184,10 @@ def test_local_nonstatconv_oracle(rng):
 def test_distributed_nonstatconv(rng):
     """Distributed factory equals the serial global operator
     (ref tests' oracle pattern)."""
-    n = 64
+    n = 16 * P  # the factory requires n divisible by the shard count
     nh = 5
-    hs = rng.standard_normal((16, nh))
-    ih = np.arange(2, 64, 4)
+    hs = rng.standard_normal((n // 4, nh))
+    ih = np.arange(2, n, 4)
     Op = MPINonStationaryConvolve1D(n, hs, ih, dtype=np.float64)
     serial = NonStationaryConvolve1D((n,), hs, ih, dtype=np.float64)
     x = rng.standard_normal(n)
@@ -186,15 +205,15 @@ def test_halo_3d_grid(rng):
     """3-D Cartesian process grid (2x2x2): forward pads every axis with
     neighbour slabs, corners relayed axis-by-axis; adjoint crops back to
     the exact input (ref Halo.py:320-423)."""
-    dims = (4, 6, 8)
-    grid = (2, 2, 2)
+    grid = _grid3(P)
+    dims = (2 * grid[0], 3 * grid[1], 4 * grid[2])
     x = rng.standard_normal(dims)
     flat, sizes = _block_flat(x, grid)
     Hop = MPIHalo(dims=dims, halo=1, proc_grid_shape=grid, dtype=np.float64)
     dx = DistributedArray.to_dist(flat, local_shapes=sizes)
     y = Hop.matvec(dx)
     locs = y.local_arrays()
-    for r in range(8):
+    for r in range(P):
         sl = halo_block_split(dims, r, grid)
         coords = np.unravel_index(r, grid)
         lohi = []
@@ -216,7 +235,8 @@ def test_halo_3d_hlo_neighbor_exchange(rng):
     """3-D halo lowering is still boundary-slab collective-permutes."""
     import jax
 
-    dims, grid = (4, 4, 4), (2, 2, 2)
+    grid = _grid3(P)
+    dims = (2 * grid[0], 2 * grid[1], 2 * grid[2])
     x = rng.standard_normal(dims)
     flat, sizes = _block_flat(x, grid)
     Hop = MPIHalo(dims=dims, halo=1, proc_grid_shape=grid,
@@ -237,7 +257,8 @@ def test_distributed_nonstatconv_sweep(rng, nh, nfilt):
     from pylops_mpi_tpu.ops.local import NonStationaryConvolve1D as LocalNSC
     import jax.numpy as jnp
 
-    n = 64
+    n = 16 * P  # divisible by the shard count (factory requirement)
+    nfilt = nfilt * P // 8 if P >= 4 else nfilt // 2
     hs = rng.standard_normal((nfilt, nh))
     # regular spacing with filters inside every shard and a halo width
     # the one-hop neighbour exchange supports
@@ -280,12 +301,12 @@ def _halo_oracle(Hop, x_np):
 
 
 _GRID_PARS = [
-    {"dims": (16,), "grid": (8,)},
-    {"dims": (16, 4), "grid": (8, 1)},
-    {"dims": (4, 16), "grid": (1, 8)},
-    {"dims": (16, 3, 4), "grid": (8, 1, 1)},
-    {"dims": (3, 16, 4), "grid": (1, 8, 1)},
-    {"dims": (3, 4, 16), "grid": (1, 1, 8)},
+    {"dims": (2 * P,), "grid": (P,)},
+    {"dims": (2 * P, 4), "grid": (P, 1)},
+    {"dims": (4, 2 * P), "grid": (1, P)},
+    {"dims": (2 * P, 3, 4), "grid": (P, 1, 1)},
+    {"dims": (3, 2 * P, 4), "grid": (1, P, 1)},
+    {"dims": (3, 4, 2 * P), "grid": (1, 1, P)},
 ]
 
 
@@ -319,8 +340,9 @@ def test_halo_grid_sweep(rng, par, halo_kind):
     np.testing.assert_allclose(np.asarray(z.asarray()), flat, rtol=1e-14)
 
 
-@pytest.mark.parametrize("dims,grid", [((23,), (8,)), ((23, 3), (8, 1)),
-                                       ((3, 23), (1, 8))])
+@pytest.mark.parametrize("dims,grid",
+                         [((3 * P - 1,), (P,)), ((3 * P - 1, 3), (P, 1)),
+                          ((3, 3 * P - 1), (1, P))])
 def test_halo_uneven_global_size(rng, dims, grid):
     """Ragged ceil-split blocks (ref test_halo.py:236-287): the ragged
     tail shard still receives its minus-neighbour's VALID tail rows."""
@@ -342,10 +364,10 @@ def test_halo_sandwich_first_derivative(rng, dtype):
     idiom, ref test_halo.py:344-427), real and complex."""
     from pylops_mpi_tpu import MPIBlockDiag
     from pylops_mpi_tpu.ops.local import FirstDerivative
-    n = 32
+    n = 4 * P
     Hop = MPIHalo(dims=(n,), halo=1, dtype=dtype)
     locals_ = []
-    for r in range(8):
+    for r in range(P):
         ext = Hop.extents[r][0]
         locals_.append(FirstDerivative((ext,), kind="centered",
                                        dtype=dtype))
@@ -369,18 +391,19 @@ def test_halo_sandwich_first_derivative(rng, dtype):
 def test_halo_rejects_broadcast_and_negative(rng):
     """Validation parity (ref test_halo.py:81-144)."""
     from pylops_mpi_tpu import Partition
+    n = 3 * P
     with pytest.raises(ValueError, match="non-negative"):
-        MPIHalo(dims=(16,), halo=-1, dtype=np.float64)
+        MPIHalo(dims=(n,), halo=-1, dtype=np.float64)
     with pytest.raises(ValueError, match="non-negative"):
-        MPIHalo(dims=(16, 4), halo=(1, -1), proc_grid_shape=(8, 1),
+        MPIHalo(dims=(n, 4), halo=(1, -1), proc_grid_shape=(P, 1),
                 dtype=np.float64)
     with pytest.raises(ValueError, match="Invalid halo length"):
-        MPIHalo(dims=(16,), halo=(1, 1, 1), dtype=np.float64)
+        MPIHalo(dims=(n,), halo=(1, 1, 1), dtype=np.float64)
     with pytest.raises(ValueError, match="does not match mesh"):
-        MPIHalo(dims=(16, 4), halo=1, proc_grid_shape=(2, 2),
+        MPIHalo(dims=(n, 4), halo=1, proc_grid_shape=(P + 1, 1),
                 dtype=np.float64)
-    Hop = MPIHalo(dims=(16,), halo=1, dtype=np.float64)
-    xb = DistributedArray.to_dist(rng.standard_normal(16),
+    Hop = MPIHalo(dims=(n,), halo=1, dtype=np.float64)
+    xb = DistributedArray.to_dist(rng.standard_normal(n),
                                   partition=Partition.BROADCAST)
     with pytest.raises(ValueError, match="SCATTER"):
         Hop.matvec(xb)

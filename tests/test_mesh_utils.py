@@ -31,20 +31,21 @@ def test_best_grid_2d_properties(n, expected_prod):
             assert abs(pr - pc) <= abs(a - n // a)
 
 
-@pytest.mark.parametrize("N,M,exp_d", [(64, 64, 2), (1, 64, 1), (64, 2, 2),
-                                       (3, 3, 2)])
-def test_active_grid_comm(N, M, exp_d):
+@pytest.mark.parametrize("N,M", [(64, 64), (1, 64), (64, 2), (3, 3)])
+def test_active_grid_comm(N, M):
     """Largest-square active grid with min(N, M) cap and row-major
     device selection (ref MatrixMult.py:24-79 semantics), plus a SUMMA
     matmul running on the returned sub-mesh."""
+    import math
     from pylops_mpi_tpu.basicoperators import active_grid_comm
-    mesh, grid, active, is_full = active_grid_comm(N, M, n_devices=8)
-    d = min(N, M, 2)  # isqrt(8) == 2
-    assert grid == (d, d) == (exp_d, exp_d)
+    P = len(jax.devices())
+    mesh, grid, active, is_full = active_grid_comm(N, M, n_devices=P)
+    p_prime = math.isqrt(P)
+    d = min(N, M, p_prime)
+    assert grid == (d, d)
     assert mesh.devices.shape == grid
-    p_prime = 2
     assert active == [r * p_prime + c for r in range(d) for c in range(d)]
-    assert is_full == (len(active) == 8)
+    assert is_full == (len(active) == P)
 
     # the returned mesh itself drives a real SUMMA product (its device
     # array reshapes to the grid inside _MPISummaMatrixMult)
@@ -60,11 +61,13 @@ def test_active_grid_comm(N, M, exp_d):
 
 
 def test_make_mesh_2d_shapes():
-    m = make_mesh_2d(grid=(2, 4))
-    assert m.devices.shape == (2, 4)
+    P = len(jax.devices())
+    grid = (2, P // 2) if P % 2 == 0 else (1, P)
+    m = make_mesh_2d(grid=grid)
+    assert m.devices.shape == grid
     assert m.axis_names == ("r", "c")
     with pytest.raises(ValueError):
-        make_mesh_2d(grid=(3, 3))  # does not tile 8 devices
+        make_mesh_2d(grid=(P + 1, 1))  # does not tile the device count
 
 
 def test_axis_sharding_specs():

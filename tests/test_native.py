@@ -3,6 +3,7 @@ fallback, threaded IO, and the DistributedArray wiring
 (ref pad-to-max idiom: pylops_mpi/utils/_nccl.py:363-403; to_dist /
 asarray: pylops_mpi/DistributedArray.py:408-461, 371-406)."""
 
+import jax
 import numpy as np
 import pytest
 
@@ -79,11 +80,13 @@ def test_read_binary_offset(tmp_path, rng):
 
 def test_to_dist_uneven_uses_native_and_matches(rng):
     # 10 rows over 8 shards -> uneven: exercises the native pack path
-    x = rng.standard_normal((10, 6)).astype(np.float32)
+    P = len(jax.devices())
+    # P+1 rows over P shards: uneven at EVERY device count
+    x = rng.standard_normal((P + 1, 6)).astype(np.float32)
     d = DistributedArray.to_dist(x, partition=Partition.SCATTER, axis=0)
     np.testing.assert_allclose(d.asarray(), x, rtol=1e-6)
     locs = d.local_arrays()
-    assert [la.shape[0] for la in locs[:2]] == [2, 2]
+    assert [la.shape[0] for la in locs] == [2] + [1] * (P - 1)
     np.testing.assert_allclose(np.concatenate(locs, axis=0), x, rtol=1e-6)
 
 
@@ -102,10 +105,11 @@ def test_dot_mismatched_local_shapes(rng):
     # dot between two splits of the same global vector (e.g. a balanced
     # to_dist vector vs a single-block MPIBlockDiag output whose layout
     # is (700,0,...)) must rebalance, not broadcast-fail
-    x = rng.standard_normal(10)
-    a = DistributedArray.to_dist(x, axis=0)  # balanced 2,2,1,... over 8
+    P = len(jax.devices())
+    x = rng.standard_normal(P + 2)
+    a = DistributedArray.to_dist(x, axis=0)  # balanced 2,2,1,... shards
     b = DistributedArray.to_dist(x, axis=0,
-                                 local_shapes=[(10,)] + [(0,)] * 7)
+                                 local_shapes=[(P + 2,)] + [(0,)] * (P - 1))
     np.testing.assert_allclose(np.asarray(a.dot(b)), x @ x, rtol=1e-12)
     np.testing.assert_allclose(np.asarray(b.dot(a)), x @ x, rtol=1e-12)
 

@@ -1,6 +1,7 @@
 """MPIStackedLinearOperator algebra + reshaped decorator + deps flags —
 mirrors the reference's ``tests/test_stackedlinearop.py`` patterns."""
 
+import jax
 import numpy as np
 import pytest
 import jax.numpy as jnp
@@ -400,12 +401,19 @@ def test_reshaped_stacking_rebalances(rng):
 
     # DISTINCT m/n layouts (both sum to 48) so a forward/adjoint
     # shape-selection swap cannot pass undetected
-    sizes_m = [(7,), (7,), (7,), (7,), (5,), (5,), (5,), (5,)]
-    sizes_n = [(5,), (5,), (5,), (5,), (7,), (7,), (7,), (7,)]
+    # distinct per-shard layouts with equal totals at any even/odd P:
+    # m = [7,5,7,5,...], n = [5,7,5,7,...] pairwise-swapped, plus a
+    # balanced 6 on a lone trailing shard when P is odd
+    P = len(jax.devices())
+    sizes_m = [(7,) if i % 2 == 0 else (5,) for i in range(P)]
+    sizes_n = [(5,) if i % 2 == 0 else (7,) for i in range(P)]
+    if P % 2:
+        sizes_m[-1] = sizes_n[-1] = (6,)
+    total = sum(s[0] for s in sizes_m)
 
     class Probe(MPILinearOperator):
         def __init__(self):
-            super().__init__(shape=(48, 48), dtype=np.float64)
+            super().__init__(shape=(total, total), dtype=np.float64)
             self.local_shapes_m = tuple(sizes_m)
             self.local_shapes_n = tuple(sizes_n)
             self.seen = None
@@ -421,7 +429,7 @@ def test_reshaped_stacking_rebalances(rng):
             return x * 2.0
 
     Op = Probe()
-    v = rng.standard_normal(48)
+    v = rng.standard_normal(total)
     # deliberately enter with the default balanced layout (6 each)
     x = DistributedArray.to_dist(v)
     assert tuple(tuple(s) for s in x.local_shapes) not in (

@@ -293,7 +293,9 @@ def test_todense_on_summa_submesh(rng):
     default mesh even for operators on a sub-mesh)."""
     import pylops_mpi_tpu as pmt
     from pylops_mpi_tpu.basicoperators import active_grid_comm
-    mesh, grid, active, _ = active_grid_comm(16, 16, n_devices=8)
+    import jax as _jax
+    mesh, grid, active, _ = active_grid_comm(
+        16, 16, n_devices=len(_jax.devices()))
     A = rng.standard_normal((6, 5)).astype(np.float64)
     Mop = pmt.MPIMatrixMult(A, M=4, kind="summa", mesh=mesh, grid=grid,
                             dtype=np.float64)
