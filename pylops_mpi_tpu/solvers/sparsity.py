@@ -268,6 +268,28 @@ def _ista_fused(Op, y: Vector, x0: Vector, alpha, eps, tol, decay,
         tv = decay_arr[jnp.minimum(iiter, nd - 1)] * thresh
         return _apply_thresh(v, threshf, tv)
 
+    def _relayout_like(template, v):
+        """``v`` in ``template``'s shard layout (no-op when they already
+        match). The while_loop carry must keep a STABLE pytree: with a
+        sparsifying transform whose data layout differs from the
+        model's (ragged shard counts, e.g. 8 blocks over 5 devices),
+        ``SOp.matvec`` hands back a different layout than the carry
+        entered with and tracing fails on pytree mismatch. Stacked
+        vectors relayout component-wise."""
+        if (isinstance(v, StackedDistributedArray)
+                and isinstance(template, StackedDistributedArray)):
+            return StackedDistributedArray(
+                [_relayout_like(t, c) for t, c
+                 in zip(template.distarrays, v.distarrays)])
+        if (isinstance(v, DistributedArray)
+                and isinstance(template, DistributedArray)
+                and (v._axis != template._axis
+                     or tuple(v._axis_sizes)
+                     != tuple(template._axis_sizes))):
+            return DistributedArray._wrap(template._operand_phys(v),
+                                          template)
+        return v
+
     def body(state):
         x, z, t, iiter, cost, _ = state
         xin = z if momentum else x
@@ -291,7 +313,8 @@ def _ista_fused(Op, y: Vector, x0: Vector, alpha, eps, tol, decay,
         xupdate = jnp.max(jnp.asarray((xnew - x).norm()))
         cost = lax.dynamic_update_index_in_dim(
             cost, (costdata + costreg).astype(cost.dtype), iiter, 0)
-        return (xnew, znew, tnew, iiter + 1, cost, xupdate)
+        return (_relayout_like(x, xnew), _relayout_like(z, znew), tnew,
+                iiter + 1, cost, xupdate)
 
     def cond(state):
         return (state[3] < niter) & (state[5] > tol)

@@ -22,13 +22,16 @@ def mesh():
 
 
 def test_all_to_all_resharding(mesh, rng):
-    x = jnp.asarray(rng.standard_normal((8, 16)))
+    # raw primitive contract: both axes divisible by the mesh size
+    n = int(mesh.devices.size)
+    x = jnp.asarray(rng.standard_normal((n, 2 * n)))
     got = C.all_to_all_resharding(x, mesh, old_axis=0, new_axis=1)
     np.testing.assert_allclose(np.asarray(got), np.asarray(x))
 
 
 def test_all_to_all_resharding_3d(mesh, rng):
-    x = jnp.asarray(rng.standard_normal((16, 8, 3)))
+    n = int(mesh.devices.size)
+    x = jnp.asarray(rng.standard_normal((2 * n, n, 3)))
     got = C.all_to_all_resharding(x, mesh, old_axis=1, new_axis=0)
     np.testing.assert_allclose(np.asarray(got), np.asarray(x))
 
@@ -85,7 +88,7 @@ def test_ring_halo_extend_emits_ppermute_only(mesh, rng):
         return shard_map(kernel, mesh=mesh, in_specs=P(name),
                          out_specs=P(name), check_vma=False)(x)
 
-    x = jnp.asarray(rng.standard_normal(64))
+    x = jnp.asarray(rng.standard_normal(8 * n))
     hlo = jax.jit(f).lower(x).compile().as_text()
     assert "collective-permute" in hlo
     assert "all-gather" not in hlo
@@ -99,7 +102,8 @@ def test_make_mesh_hybrid_single_host():
     mesh = make_mesh_hybrid()
     assert mesh.axis_names == ("dcn", "sp")
     assert mesh.devices.shape == (1, len(jax.devices()))
-    x = jnp.arange(16.0).reshape(8, 2)
+    n = len(jax.devices())
+    x = jnp.arange(4.0 * n).reshape(2 * n, 2)
     xs = jax.device_put(x, NamedSharding(mesh, P("sp", None)))
     np.testing.assert_allclose(np.asarray(jnp.sum(xs, axis=0)),
                                np.asarray(x).sum(axis=0))

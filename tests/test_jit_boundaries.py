@@ -59,16 +59,16 @@ def test_masked_solve_single_program(rng):
     half = P // 2 or 1
     mask = [i // half for i in range(P)]
     mats = []
-    for _ in range(8):
+    for _ in range(P):  # one block per shard: groups stay decoupled
         a = rng.standard_normal((4, 4))
         mats.append(a @ a.T + 4 * np.eye(4))
     Op = MPIBlockDiag([MatrixMult(m, dtype=np.float64) for m in mats],
                       mask=mask)
     import scipy.linalg as spla
     dense = spla.block_diag(*mats)
-    xtrue = rng.standard_normal(32)
+    xtrue = rng.standard_normal(4 * P)
     dy = DistributedArray.to_dist(dense @ xtrue, mask=mask)
-    x0 = DistributedArray.to_dist(np.zeros(32), mask=mask)
+    x0 = DistributedArray.to_dist(np.zeros(4 * P), mask=mask)
 
     fn = jax.jit(lambda y, x: _cg_fused(Op, y, x, 100, 1e-13)[0])
     got = fn(dy, x0)
@@ -132,9 +132,10 @@ def test_fused_solver_no_host_sync_per_iter(rng):
     """The fused CGLS lowers to one while loop: iteration count in the
     HLO is data-dependent, not unrolled (SURVEY §3.2's 4-host-syncs-per-
     iteration pathology eliminated)."""
-    mats = [rng.standard_normal((4, 4)) for _ in range(8)]
+    P = len(jax.devices())
+    mats = [rng.standard_normal((4, 4)) for _ in range(P)]
     Op = MPIBlockDiag([MatrixMult(m, dtype=np.float64) for m in mats])
-    dy = DistributedArray.to_dist(rng.standard_normal(32))
+    dy = DistributedArray.to_dist(rng.standard_normal(4 * P))
     x0 = dy.zeros_like()
     hlo = jax.jit(
         lambda y, x: _cgls_fused(Op, y, x, 50, 0.0, 0.0)[0]._arr
@@ -175,10 +176,12 @@ def test_fused_cgls_collective_schedule_is_scalar_only(rng):
     from pylops_mpi_tpu.solvers.basic import _cgls_fused, _cgls_fused_normal
     from pylops_mpi_tpu.utils import collective_report
 
+    P = len(jax.devices())  # aligned layouts: the 3-scalar pin is
+    # the even-split schedule; ragged repacks legitimately add reduces
     blocks = [rng.standard_normal((32, 32)).astype(np.float32)
-              for _ in range(8)]
+              for _ in range(P)]
     y = DistributedArray.to_dist(
-        rng.standard_normal(256).astype(np.float32))
+        rng.standard_normal(32 * P).astype(np.float32))
     for cd, solver in ((None, _cgls_fused), (jnp.bfloat16,
                                              _cgls_fused_normal)):
         Op = MPIBlockDiag([MatrixMult(b, dtype=np.float32)
@@ -207,11 +210,12 @@ def test_fused_ista_collective_schedule_is_scalar_only(rng, momentum):
     from pylops_mpi_tpu.solvers.sparsity import _ista_fused, _THRESHF
     from pylops_mpi_tpu.utils import collective_report
 
+    P = len(jax.devices())
     blocks = [rng.standard_normal((16, 16)).astype(np.float32)
-              for _ in range(8)]
+              for _ in range(P)]
     Op = MPIBlockDiag([MatrixMult(b, dtype=np.float32) for b in blocks])
     y = DistributedArray.to_dist(
-        rng.standard_normal(128).astype(np.float32))
+        rng.standard_normal(16 * P).astype(np.float32))
 
     def run(yy, xx):
         return _ista_fused(Op, yy, xx, 0.2, 0.1, 0.0,

@@ -45,10 +45,12 @@ def test_batched_normal_matvec_oracle(rng):
 def test_blockdiag_normal_matvec_matches_two_sweeps(rng):
     from pylops_mpi_tpu import MPIBlockDiag, DistributedArray
     from pylops_mpi_tpu.ops.local import MatrixMult
-    blocks = [rng.standard_normal((12, 8)) for _ in range(8)]
+    import jax
+    P = len(jax.devices())  # batched path needs nblocks %% P == 0
+    blocks = [rng.standard_normal((12, 8)) for _ in range(P)]
     Op = MPIBlockDiag([MatrixMult(b, dtype=np.float64) for b in blocks])
     assert Op.has_fused_normal
-    x = DistributedArray.to_dist(rng.standard_normal(8 * 8))
+    x = DistributedArray.to_dist(rng.standard_normal(P * 8))
     u, q = Op.normal_matvec(x)
     q_ref = Op.matvec(x)
     u_ref = Op.rmatvec(q_ref)

@@ -76,6 +76,9 @@ def test_vjp_is_rmatvec_jvp_is_matvec(rng):
     dy = DistributedArray.to_dist(rng.standard_normal(40))
 
     out, vjp = jax.vjp(Op.matvec, x)
+    # cotangent must match the primal output pytree (incl. layout)
+    dy = DistributedArray.to_dist(np.asarray(dy.asarray()),
+                                  local_shapes=out.local_shapes)
     (gx,) = vjp(dy)
     np.testing.assert_allclose(np.asarray(gx.asarray()),
                                dense.T @ np.asarray(dy.asarray()),
@@ -193,10 +196,11 @@ def test_vjp_complex_transpose_convention(rng):
     x = DistributedArray.to_dist(
         (rng.standard_normal(128)
          + 1j * rng.standard_normal(128)).astype(np.complex128))
-    _, vjp = jax.vjp(F.matvec, x)
+    fout, vjp = jax.vjp(F.matvec, x)
     ctv = (rng.standard_normal(128)
            + 1j * rng.standard_normal(128)).astype(np.complex128)
-    (g,) = vjp(DistributedArray.to_dist(ctv))
+    (g,) = vjp(DistributedArray.to_dist(
+        ctv, local_shapes=fout.local_shapes))
     ref = F.rmatvec(DistributedArray.to_dist(np.conj(ctv)))
     np.testing.assert_allclose(np.asarray(g.asarray()),
                                np.conj(np.asarray(ref.asarray())),
@@ -211,7 +215,8 @@ def test_halo_vjp_is_true_adjoint_rmatvec_is_crop(rng):
     produces the TRUE adjoint (ghost contributions summed back). Both
     facts pinned here so neither regresses silently."""
     from pylops_mpi_tpu import MPIHalo
-    n = 16
+    import jax as _jax
+    n = 2 * len(_jax.devices())
     H = MPIHalo((n,), halo=1, dtype=np.float64)
     x = DistributedArray.to_dist(rng.standard_normal(n))
     out = H.matvec(x)
