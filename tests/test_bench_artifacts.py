@@ -173,17 +173,19 @@ def test_run_json_cmd_salvages_on_timeout(bench):
             "print(json.dumps({'value': 7.5, 'partial': True}),"
             " flush=True)\n"
             "time.sleep(60)\n")
+    # generous timeout: under a loaded host (xdist workers) the child
+    # needs a few seconds just to start python and print
     got, err = bench._run_json_cmd([sys.executable, "-c", code],
-                                   dict(os.environ), timeout=5)
+                                   dict(os.environ), timeout=15)
     assert err is None
     assert got["value"] == 7.5
-    assert got["salvaged_after_timeout"] == 5
+    assert got["salvaged_after_timeout"] == 15
 
 
 def test_run_json_cmd_timeout_no_output(bench):
     got, err = bench._run_json_cmd(
         [sys.executable, "-c", "import time; time.sleep(60)"],
-        dict(os.environ), timeout=3)
+        dict(os.environ), timeout=5)
     assert got is None and "timeout" in err
 
 

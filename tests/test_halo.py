@@ -248,6 +248,7 @@ def test_halo_3d_hlo_neighbor_exchange(rng):
     assert "all-gather" not in txt and "all_gather" not in txt
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("nh,nfilt", [(3, 16), (7, 16)])
 def test_distributed_nonstatconv_sweep(rng, nh, nfilt):
     """Distributed non-stationary convolution vs the local oracle for
@@ -310,6 +311,7 @@ _GRID_PARS = [
 ]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("par", _GRID_PARS)
 @pytest.mark.parametrize("halo_kind", ["scalar", "ndim_tuple",
                                        "per_side_tuple"])
@@ -340,6 +342,7 @@ def test_halo_grid_sweep(rng, par, halo_kind):
     np.testing.assert_allclose(np.asarray(z.asarray()), flat, rtol=1e-14)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("dims,grid",
                          [((3 * P - 1,), (P,)), ((3 * P - 1, 3), (P, 1)),
                           ((3, 3 * P - 1), (1, P))])

@@ -112,6 +112,7 @@ def test_grad_through_stencil(rng):
                                rtol=1e-10, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_grad_tv_like_objective_stacked(rng):
     """A composite objective (data misfit + gradient-smoothness) over a
     StackedDistributedArray output differentiates end to end."""
@@ -207,6 +208,7 @@ def test_vjp_complex_transpose_convention(rng):
                                atol=1e-12)
 
 
+@pytest.mark.slow
 def test_halo_vjp_is_true_adjoint_rmatvec_is_crop(rng):
     """MPIHalo.rmatvec mirrors the reference's crop-only adjoint
     (ref ``Halo.py:400-423``): it extracts the core region, which makes
