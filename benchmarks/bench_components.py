@@ -108,28 +108,85 @@ def _bench_first_derivative(pmt, rng, n_dev, scale):
 
 
 def _bench_summa(pmt, rng, n_dev, scale):
+    """SUMMA with the attribution matrix the round-4 VERDICT asked
+    for: how much of the deficit vs NumPy is (a) XLA-vs-BLAS GEMM
+    speed (single-device row, no mesh), (b) the mesh carve +
+    collectives (gather schedule on both grid shapes), (c) fixable
+    scheduling (stationary-A — auto's pick at this skinny-RHS shape —
+    vs forced gather)."""
     import jax
     import jax.numpy as jnp
     N = 1024 * scale
+    flops = 2 * N * N * 64
     A = rng.standard_normal((N, N)).astype(np.float32)
     X = rng.standard_normal((N, 64)).astype(np.float32)
-    Mop = pmt.MPIMatrixMult(A, M=64, kind="summa", dtype=np.float32)
     xd = pmt.DistributedArray.to_dist(X.ravel())
-    fn = jax.jit(lambda v: Mop.matvec(v).array)
-    dt = _timeit(fn, xd, inner=5)
+
+    def _gf(op):
+        fn = jax.jit(lambda v: op.matvec(v).array)
+        return flops / _timeit(fn, xd, inner=5) / 1e9
+
+    gf = _gf(pmt.MPIMatrixMult(A, M=64, kind="summa", dtype=np.float32))
+
+    attrib = {}
+
+    def _row(key, fn):
+        # per-row guard: one failing variant must not cost the others
+        try:
+            attrib[key] = round(fn(), 1)
+        except Exception as e:
+            attrib[key] = None
+            attrib.setdefault("errors", {})[key] = repr(e)[:120]
+
+    # (a) one XLA device, no mesh, no collectives: pure XLA-vs-BLAS
+    def _single():
+        Ad = jax.device_put(jnp.asarray(A), jax.devices()[0])
+        Xd = jax.device_put(jnp.asarray(X), jax.devices()[0])
+        f1 = jax.jit(lambda a, x: a @ x)
+        return flops / _timeit(f1, Ad, Xd, inner=5) / 1e9
+    _row("single_dev_xla_gflops", _single)
+    # (b) grid-shape sensitivity of the gather schedule (only grids
+    # that tile the actual device count — n_dev=5 has none)
+    grids = {g for g in ((2, n_dev // 2), (n_dev // 2, 2))
+             if g[0] >= 2 and g[1] >= 2 and g[0] * g[1] == n_dev}
+    for g in sorted(grids):
+        _row(f"gather_grid_{g[0]}x{g[1]}_gflops",
+             lambda g=g: _gf(pmt.MPIMatrixMult(
+                 A, M=64, kind="summa", grid=g, dtype=np.float32,
+                 schedule="gather")))
+    # (c) stationary-A (zero bytes of A on the wire) vs gather
+    _row("stat_a_gflops",
+         lambda: _gf(pmt.MPIMatrixMult(A, M=64, kind="summa",
+                                       dtype=np.float32,
+                                       schedule="stat_a")))
+    # partitioner-derived schedule for reference
+    _row("auto_kind_gflops",
+         lambda: _gf(pmt.MPIMatrixMult(A, M=64, kind="auto",
+                                       dtype=np.float32)))
+
     # bf16 tile storage + f32 MXU accumulation (the TPU-native format)
     Mlo = pmt.MPIMatrixMult(A, M=64, kind="summa", dtype=np.float32,
                             compute_dtype=jnp.bfloat16)
     flo = jax.jit(lambda v: Mlo.matvec(v).array)
     dt_lo = _timeit(flo, xd, inner=5)
-    np_gf = 2 * N * N * 64 / _timeit_np(lambda: A @ X) / 1e9
-    gf = 2 * N * N * 64 / dt / 1e9
-    return {"bench": "summa_matmul",
-            "value": round(gf, 1), "unit": "GFLOP/s",
-            "bf16_gflops": round(2 * N * N * 64 / dt_lo / 1e9, 1),
-            "numpy_gflops": round(np_gf, 1),
-            "vs_numpy": round(gf / np_gf, 2),
-            "shape": f"{N}x{N}@{N}x64"}
+    np_gf = flops / _timeit_np(lambda: A @ X) / 1e9
+    row = {"bench": "summa_matmul",
+           "value": round(gf, 1), "unit": "GFLOP/s",
+           "bf16_gflops": round(flops / dt_lo / 1e9, 1),
+           "numpy_gflops": round(np_gf, 1),
+           "vs_numpy": round(gf / np_gf, 2),
+           "attribution": attrib,
+           "shape": f"{N}x{N}@{N}x64"}
+    try:  # GEMM-bound rows carry MFU on TPU (round-4 VERDICT next #5);
+        # gf is the AGGREGATE rate of the distributed apply, so
+        # normalise by all chips' peak like the flagship does
+        import bench as _bench
+        peak = _bench._peak_flops_per_chip(jax.devices()[0], "f32_highest")
+        if peak:
+            row["mfu"] = _bench._sig3(gf * 1e9 / (peak * n_dev))
+    except Exception:
+        pass
+    return row
 
 
 def _bench_fft(pmt, rng, n_dev, scale):
@@ -166,21 +223,31 @@ def _bench_dft_engine(pmt, rng, n_dev, scale):
     # two MDC-realistic regimes (round-3 VERDICT next #7): many small
     # batched transforms (the Fredholm/MDC frequency sweep) and one
     # long axis (where O(n·base) GEMM-DFT loses hardest to O(n log n))
-    cases = {"batched_small": (128 * scale, 1024),
-             "long_axis": (4, 65536 * scale)}
+    cases = {"batched_small": (128 * scale, 1024, False),
+             "long_axis": (4, 65536 * scale, False),
+             # MDC's transforms are REAL-input: the packed-real path
+             # (one half-length complex FFT + untangle) vs jnp.fft.rfft
+             "batched_rfft": (128 * scale, 1024, True)}
     out = {}
     try:
-        for tag, (batch, n) in cases.items():
-            x = (rng.standard_normal((batch, n))
-                 + 1j * rng.standard_normal((batch, n))
-                 ).astype(np.complex64)
+        for tag, (batch, n, real) in cases.items():
+            if real:
+                x = rng.standard_normal((batch, n)).astype(np.float32)
+                flops = 2.5 * batch * n * np.log2(n)  # rfft convention
+            else:
+                x = (rng.standard_normal((batch, n))
+                     + 1j * rng.standard_normal((batch, n))
+                     ).astype(np.complex64)
+                flops = 5 * batch * n * np.log2(n)  # FFT flop convention
             xd = jnp.asarray(x)
-            flops = 5 * batch * n * np.log2(n)  # FFT flop convention
             row = {}
             for mode in ("matmul", "xla"):
                 dft.set_fft_mode(mode)  # env is ignored after first use
                 try:
-                    fn = jax.jit(lambda v: dft.fft(v, axis=-1))
+                    if real:
+                        fn = jax.jit(lambda v: dft.rfft(v, axis=-1))
+                    else:
+                        fn = jax.jit(lambda v: dft.fft(v, axis=-1))
                     jax.block_until_ready(fn(xd))  # compile + probe
                     dt = _timeit(fn, xd, inner=10)
                     row[mode] = round(flops / dt / 1e9, 1)
@@ -232,6 +299,69 @@ def _bench_fredholm(pmt, rng, n_dev, scale):
             "numpy_gflops": round(np_gf, 1),
             "vs_numpy": round(gf / np_gf, 2),
             "shape": f"{nsl}x{nx_}x{ny_}"}
+
+
+def _bench_ragged_overhead(pmt, rng, n_dev, scale):
+    """Cost of the specialization-contract cliffs (round-4 VERDICT
+    weak #5, next #6): the batched BlockDiag GEMM needs
+    ``nblocks % P == 0`` and Fredholm1's zero-collective path needs
+    ``nsl % P == 0`` — both degrade gracefully to slower correct
+    paths at non-dividing counts, and this row measures what the
+    ragged layout actually costs a P=8 user (per-block normalised,
+    so 9-vs-8 blocks is apples-to-apples)."""
+    import jax
+
+    out = {}
+    # BlockDiag: n_dev blocks (batched GEMM path) vs n_dev+1 (ragged)
+    nb = 256 * scale
+    def _bd_per_block(nblocks):
+        blocks = [rng.standard_normal((nb, nb)).astype(np.float32)
+                  for _ in range(nblocks)]
+        Op = pmt.MPIBlockDiag([pmt.ops.local.MatrixMult(b) for b in blocks])
+        xd = pmt.DistributedArray.to_dist(
+            rng.standard_normal(Op.shape[1]).astype(np.float32))
+        fn = jax.jit(lambda v: Op.rmatvec(Op.matvec(v)).array)
+        return _timeit(fn, xd, inner=5) / nblocks, Op
+
+    t_even, op_even = _bd_per_block(n_dev)
+    t_ragged, op_ragged = _bd_per_block(n_dev + 1)
+    out["blockdiag"] = {
+        "batched_path_even": op_even._batched is not None,
+        "batched_path_ragged": op_ragged._batched is not None,
+        "per_block_ms_even": round(t_even * 1e3, 3),
+        "per_block_ms_ragged": round(t_ragged * 1e3, 3),
+        "ragged_cost_x": round(t_ragged / t_even, 2),
+        "shape": f"{n_dev}+1 blocks of {nb}^2, P={n_dev}"}
+
+    # Fredholm1: at nsl % P == 0 the slice-aligned SCATTER model rides
+    # the zero-collective path; at nsl % P != 0 that layout is
+    # unavailable (the contract) and the user falls back to BROADCAST —
+    # the cliff is the difference between those two real options.
+    nx_, ny_, nz_ = 64, 64, 4
+    def _fr_per_slice(nsl, aligned):
+        G = rng.standard_normal((nsl, nx_, ny_)).astype(np.float32)
+        Fr = pmt.MPIFredholm1(G, nz=nz_, dtype=np.float32)
+        kw = (dict(local_shapes=Fr.model_local_shapes) if aligned
+              else dict(partition=pmt.Partition.BROADCAST))
+        xs = pmt.DistributedArray.to_dist(
+            rng.standard_normal(Fr.shape[1]).astype(np.float32), **kw)
+        fn = jax.jit(lambda v: Fr.matvec(v).array)
+        return _timeit(fn, xs, inner=5) / nsl
+
+    nsl0 = 8 * n_dev * scale
+    t_even = _fr_per_slice(nsl0, True)
+    t_ragged = _fr_per_slice(nsl0 + 1, False)
+    out["fredholm1"] = {
+        "per_slice_us_even": round(t_even * 1e6, 2),
+        "per_slice_us_ragged": round(t_ragged * 1e6, 2),
+        "ragged_cost_x": round(t_ragged / t_even, 2),
+        "shape": f"nsl={nsl0}(+1) {nx_}x{ny_}x{nz_}, P={n_dev}"}
+
+    worst = max(out["blockdiag"]["ragged_cost_x"],
+                out["fredholm1"]["ragged_cost_x"])
+    return {"bench": "ragged_overhead",
+            "value": worst, "unit": "x (ragged/even per-item cost)",
+            "cases": out}
 
 
 def _bench_poststack(pmt, rng, n_dev, scale):
@@ -394,6 +524,7 @@ _BENCHES = [("first_derivative_halo", _bench_first_derivative),
             ("mdc_apply", _bench_mdc),
             ("cgls_multirhs", _bench_cgls_multirhs),
             ("precision_pin", _bench_precision_pin),
+            ("ragged_overhead", _bench_ragged_overhead),
             # LAST: its xla-mode probe can wedge an FFT-less runtime's
             # process (benign when isolated; ordering protects the
             # in-process fallback path)

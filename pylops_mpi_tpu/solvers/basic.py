@@ -46,9 +46,9 @@ def _abs(v):
 
 
 def _mp_floor(k0):
-    """Machine-precision floor for the squared gradient norm: once
-    ``k = |Aᴴr|²`` falls below ``(100·eps)²·k0`` further updates are
-    numerical noise. The fused loops FREEZE the recurrence there (zero
+    """Machine-precision floor for the solver's squared recurrence
+    norm — ``k = |r|²`` for CG, ``k = |Aᴴr|²`` for CGLS: once ``k``
+    falls below ``(100·eps)²·k0`` further updates are numerical noise. The fused loops FREEZE the recurrence there (zero
     step + zero momentum) instead of exiting: iterating past this point
     is not just useless, it is unstable — the ``k/kold`` ratio of
     noise-level quantities can drift above 1 and pump the recurrence

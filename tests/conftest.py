@@ -41,11 +41,25 @@ def rng():
 def _reset_fft_mode():
     """The local-FFT engine mode is cached at first use for determinism
     (ops/dft.py); tests that monkeypatch PYLOPS_MPI_TPU_FFT_MODE need a
-    fresh resolution each test."""
+    fresh resolution each test.
+
+    Unlike ``set_fft_mode``, this does NOT clear JAX's jit caches.
+    That is safe *for this suite* because no compiled executable can
+    survive a mode flip into the wrong test: the fused-solver cache is
+    keyed on ``id(Op)`` with the operator instance pinned in the entry
+    (solvers/basic.py ``_get_fused``) and every test builds fresh
+    instances; operator matvec jits and shard_map kernels are
+    per-instance / per-call closures (new function identity → retrace,
+    which re-resolves the mode); and eager ``dft.fft``-family calls
+    branch on the mode in Python before any dispatch. Code outside the
+    suite that flips modes on live operators must use ``set_fft_mode``.
+    """
     from pylops_mpi_tpu.ops import dft
     dft._mode_cache = None
+    dft._base_cache = None
     yield
     dft._mode_cache = None
+    dft._base_cache = None
 
 
 @pytest.fixture(scope="session")

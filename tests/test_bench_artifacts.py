@@ -222,12 +222,61 @@ def test_cached_bf16_primary_reranked_to_f32(bench, tmp_path):
     assert merged["cached"] and merged["value"] == 1339.0
     assert merged["vs_baseline"] == 0.53
     assert merged["gflops"] == 5.6
-    # mfu rescaled by f32/bf16 gflops ratio: 0.02 * 5.6/3.2 = 0.035
-    assert abs(merged["mfu"] - 0.035) < 1e-9
+    # legacy artifact: banked mfu 0.02 was vs the bf16 peak; the
+    # promoted f32 number reports vs the f32-highest peak (bf16/6),
+    # so rescale is 6 * 0.02 * 5.6/3.2 = 0.21
+    assert abs(merged["mfu"] - 0.21) < 1e-9
     assert merged["bf16"]["iters_per_sec"] == 772.0
     assert "promoted to primary" in merged["metric"]
     assert "bf16" not in merged["metric"]  # label rewritten
     assert "rel_err=1e-06" in merged["metric"]
+
+
+def test_rerank_mfu_prefers_banked_per_mode_value(bench, tmp_path):
+    """New artifacts bank f32.mfu directly; the re-rank must use it
+    verbatim (no rescale), and a tiny-but-real value must survive —
+    0.0 coercion to null was the round-4 bug."""
+    import json
+    cache = {"flagship_small": {"ts": "t", "code_rev": "r", "result": {
+        "platform": "tpu",
+        "metric": "CGLS iters/sec (bf16-storage fused-normal,"
+                  " rel_err=2.5e-03)",
+        "value": 772.0, "unit": "iters/s", "vs_baseline": 0.31,
+        "mfu": 0.02, "gflops": 3.2, "hbm_gbps": 1.6, "n_devices": 1,
+        "peak_tflops": {"bf16": 197.0, "f32_highest": 32.8},
+        "f32": {"iters_per_sec": 1339.0, "vs_baseline": 0.53,
+                "gflops": 5.6, "hbm_gbps": 11.2, "rel_err": "1e-06",
+                "mfu": 3.2e-05},
+    }}}
+    (tmp_path / "tpu_cache.json").write_text(json.dumps(cache))
+    merged = bench._merge_tpu_cache(
+        {"platform": "cpu", "value": 12.0, "degraded": True},
+        root=str(tmp_path))
+    assert merged["value"] == 1339.0
+    assert merged["mfu"] == 3.2e-05  # tiny, non-null, unrescaled
+
+
+def test_rerank_mfu_recomputes_from_banked_peaks(bench, tmp_path):
+    """Middle branch: no per-mode mfu banked, but peaks are — recompute
+    exactly instead of rescaling through the old top-level number."""
+    import json
+    cache = {"flagship_small": {"ts": "t", "code_rev": "r", "result": {
+        "platform": "tpu",
+        "metric": "CGLS iters/sec (bf16-storage fused-normal,"
+                  " rel_err=2.5e-03)",
+        "value": 772.0, "unit": "iters/s", "vs_baseline": 0.31,
+        "mfu": 0.02, "gflops": 3.2, "hbm_gbps": 1.6, "n_devices": 2,
+        "peak_tflops": {"bf16": 197.0, "f32_highest": 32.8},
+        "f32": {"iters_per_sec": 1339.0, "vs_baseline": 0.53,
+                "gflops": 5.6, "hbm_gbps": 11.2, "rel_err": "1e-06"},
+    }}}
+    (tmp_path / "tpu_cache.json").write_text(json.dumps(cache))
+    merged = bench._merge_tpu_cache(
+        {"platform": "cpu", "value": 12.0, "degraded": True},
+        root=str(tmp_path))
+    # 5.6 GFLOP/s vs 32.8 TFLOP/s * 2 devices, 3 sig digits
+    want = float(f"{5.6 / (32.8e3 * 2):.3g}")
+    assert merged["mfu"] == want
 
 
 def test_rehearse_never_overwrites_tpu_cache(tmp_path, monkeypatch):

@@ -101,6 +101,50 @@ def test_summa_rectangular_grids(rng, grid, N, K, M):
     dottest(Op, dx, dy)
 
 
+@pytest.mark.parametrize("schedule", ["gather", "stat_a"])
+@pytest.mark.parametrize("N,K,M", [(24, 16, 8), (13, 11, 7)])
+def test_summa_schedules_match_oracle(rng, schedule, N, K, M):
+    """Both forward communication schedules (gather-A-row and
+    stationary-A reduce-scatter) must agree with the dense oracle and
+    pass the dot test, including ragged tile shapes."""
+    A, X, Y = _make_AXY(rng, N, K, M, np.float64)
+    Op = MPIMatrixMult(A, M, kind="summa", dtype=np.float64,
+                       schedule=schedule)
+    assert Op.schedule == schedule
+    dx = DistributedArray.to_dist(X.ravel())
+    dy = DistributedArray.to_dist(Y.ravel())
+    np.testing.assert_allclose(Op.matvec(dx).asarray().reshape(N, M),
+                               A @ X, rtol=1e-10, atol=1e-12)
+    np.testing.assert_allclose(Op.rmatvec(dy).asarray().reshape(K, M),
+                               A.conj().T @ Y, rtol=1e-10, atol=1e-12)
+    dottest(Op, dx, dy)
+
+
+def test_summa_schedule_auto_picks_by_bytes(rng):
+    """auto = per-device byte count: stationary-A for skinny RHS
+    (M ≪ K: A dominates the wire), gather for square-ish RHS."""
+    A = rng.standard_normal((64, 64))
+    assert MPIMatrixMult(A, M=4, kind="summa",
+                         dtype=np.float64).schedule == "stat_a"
+    assert MPIMatrixMult(A, M=64, kind="summa",
+                         dtype=np.float64).schedule == "gather"
+    with pytest.raises(ValueError, match="schedule"):
+        MPIMatrixMult(A, M=4, kind="summa", schedule="bogus")
+
+
+def test_summa_stat_a_complex(rng):
+    """Stationary-A with complex operators (conjugation lives in the
+    adjoint kernel; forward must not conjugate)."""
+    A, X, Y = _make_AXY(rng, 14, 10, 4, np.complex128)
+    Op = MPIMatrixMult(A, 4, kind="summa", dtype=np.complex128,
+                       schedule="stat_a")
+    dx = DistributedArray.to_dist(X.ravel())
+    np.testing.assert_allclose(Op.matvec(dx).asarray().reshape(14, 4),
+                               A @ X, rtol=1e-10, atol=1e-12)
+    dy = DistributedArray.to_dist(Y.ravel())
+    dottest(Op, dx, dy)
+
+
 def test_summa_complex_rect_grid(rng):
     A, X, Y = _make_AXY(rng, 14, 10, 6, np.complex128)
     grid = _rect_grids()[-2] if len(_rect_grids()) > 2 else _rect_grids()[-1]
