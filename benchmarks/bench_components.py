@@ -251,6 +251,26 @@ def _bench_dft_engine(pmt, rng, n_dev, scale):
                     jax.block_until_ready(fn(xd))  # compile + probe
                     dt = _timeit(fn, xd, inner=10)
                     row[mode] = round(flops / dt / 1e9, 1)
+                    if mode == "matmul":
+                        # actual GEMM work, not FFT-convention flops:
+                        # the engine's utilisation is only meaningful
+                        # against what it really computes
+                        # packed-real rfft = one complex transform of
+                        # half length; complex fft = full length
+                        neff = n // 2 if real else n
+                        sig = sum(dft.stage_radices(neff))
+                        gemm_flops = 8.0 * batch * neff * sig
+                        row["gemm_gflops"] = round(gemm_flops / dt / 1e9,
+                                                   1)
+                        try:
+                            import bench as _b
+                            pk = _b._peak_flops_per_chip(
+                                jax.devices()[0], "f32_highest")
+                            if pk:
+                                row["gemm_mfu"] = _b._sig3(
+                                    gemm_flops / dt / pk)
+                        except Exception:
+                            pass
                 except Exception:
                     # e.g. UNIMPLEMENTED fft custom-call; this config
                     # runs isolated on TPU so a wedge cannot poison
@@ -260,6 +280,27 @@ def _bench_dft_engine(pmt, rng, n_dev, scale):
                 row["vs_xla"] = round(row["matmul"] / row["xla"], 2)
             row["shape"] = f"{batch}x{n}"
             out[tag] = row
+        # On FFT-less TPU runtimes the matmul engine IS the transform:
+        # bank a base sweep so a live window records which radix cap
+        # the MXU actually prefers (default 128 = MXU tile; 32 halves
+        # the total GEMM work at these sizes)
+        if jax.default_backend() == "tpu":
+            sweep = {}
+            xs = jnp.asarray((rng.standard_normal((32, 1024))
+                              + 1j * rng.standard_normal((32, 1024))
+                              ).astype(np.complex64))
+            for b in (32, 128):
+                try:
+                    dft.set_fft_mode("matmul")
+                    dft._base_cache = int(b)
+                    fnb = jax.jit(lambda v: dft.fft(v, axis=-1))
+                    jax.block_until_ready(fnb(xs))
+                    sweep[str(b)] = round(
+                        5 * 32 * 1024 * np.log2(1024)
+                        / _timeit(fnb, xs, inner=10) / 1e9, 1)
+                except Exception as e:
+                    sweep[str(b)] = repr(e)[:80]
+            out["tpu_base_sweep_gflops"] = sweep
     finally:
         dft.set_fft_mode(None)
     bs = out.get("batched_small", {})

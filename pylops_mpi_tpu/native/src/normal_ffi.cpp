@@ -1,0 +1,140 @@
+// One-pass fused normal matvec for CPU hosts, as an XLA FFI custom call.
+//
+// (u, q) = (A^T A x, A x) per block of a batched block-diagonal
+// operator, reading each A block from DRAM ONCE: thread t owns a
+// contiguous row slab of every block; for each of its rows it computes
+// q[r] = <A[r], x> and immediately accumulates u_t += q[r] * A[r]
+// while the row is still in registers/L1. The classic two-sweep
+// schedule (BLAS gemv + gemv^T, what the reference's per-rank NumPy
+// engine does) reads A twice; on bandwidth-bound sizes this kernel
+// approaches 2x.
+//
+// This is the CPU analog of the Pallas `_normal_kernel`
+// (ops/pallas_kernels.py), which does the same single-sweep trick in
+// VMEM on TPU. Registered through jax.ffi so the fused CGLS
+// while_loop can call it from inside jit (native/ffi.py).
+//
+// Reference context: the reference has no first-party native compute
+// (SURVEY.md §2.6); its normal-equation products are two separate
+// rank-local BLAS calls inside the Python solver loop
+// (pylops_mpi/optimization/cls_basic.py:370-404).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+int NumThreads(int64_t rows_total) {
+  long hw = static_cast<long>(std::thread::hardware_concurrency());
+  if (const char* env = std::getenv("PYLOPS_MPI_TPU_NATIVE_THREADS")) {
+    long v = std::strtol(env, nullptr, 10);
+    if (v > 0) hw = v;
+  }
+  if (hw < 1) hw = 1;
+  // never more threads than row slabs of ~64 rows: tiny problems
+  // must not pay thread spawn for nothing
+  int64_t cap = std::max<int64_t>(1, rows_total / 64);
+  return static_cast<int>(std::min<int64_t>(hw, cap));
+}
+
+template <typename T>
+void SlabWorker(const T* A, const T* X, T* Q, T* acc, int64_t nblk,
+                int64_t m, int64_t n, int64_t r0, int64_t r1) {
+  // acc: private (nblk, n) accumulator, zero-initialised by caller
+  for (int64_t b = 0; b < nblk; ++b) {
+    const T* Ab = A + b * m * n;
+    const T* xb = X + b * n;
+    T* qb = Q + b * m;
+    T* ub = acc + b * n;
+    for (int64_t r = r0; r < r1; ++r) {
+      const T* row = Ab + r * n;
+      // 16 partial sums: enough independent chains for AVX-512 FMA
+      // without -ffast-math, deterministic summation order
+      T p[16] = {0};
+      int64_t j = 0;
+      for (; j + 16 <= n; j += 16) {
+        for (int k = 0; k < 16; ++k) p[k] += row[j + k] * xb[j + k];
+      }
+      T s = 0;
+      for (int k = 0; k < 16; ++k) s += p[k];
+      for (; j < n; ++j) s += row[j] * xb[j];
+      qb[r] = s;
+      for (int64_t k = 0; k < n; ++k) ub[k] += s * row[k];
+    }
+  }
+}
+
+template <typename T>
+ffi::Error FusedNormal(const T* A, const T* X, T* U, T* Q, int64_t nblk,
+                       int64_t m, int64_t n) {
+  const int nt = NumThreads(m);
+  if (nt <= 1) {
+    std::memset(U, 0, sizeof(T) * nblk * n);
+    SlabWorker<T>(A, X, Q, U, nblk, m, n, 0, m);
+    return ffi::Error::Success();
+  }
+  std::vector<std::vector<T>> accs(nt);
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  const int64_t slab = (m + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    accs[t].assign(static_cast<size_t>(nblk * n), T(0));
+    const int64_t r0 = t * slab;
+    const int64_t r1 = std::min<int64_t>(m, r0 + slab);
+    if (r0 >= r1) continue;
+    threads.emplace_back(SlabWorker<T>, A, X, Q, accs[t].data(), nblk, m,
+                         n, r0, r1);
+  }
+  for (auto& th : threads) th.join();
+  // deterministic tree-free reduction in fixed thread order
+  std::memset(U, 0, sizeof(T) * nblk * n);
+  for (int t = 0; t < nt; ++t) {
+    if (accs[t].empty()) continue;
+    const T* a = accs[t].data();
+    for (int64_t k = 0; k < nblk * n; ++k) U[k] += a[k];
+  }
+  return ffi::Error::Success();
+}
+
+template <ffi::DataType DT>
+ffi::Error FusedNormalDispatch(ffi::Buffer<DT> a, ffi::Buffer<DT> x,
+                               ffi::ResultBuffer<DT> u,
+                               ffi::ResultBuffer<DT> q) {
+  auto d = a.dimensions();
+  if (d.size() != 3) {
+    return ffi::Error::InvalidArgument("A must be (nblk, m, n)");
+  }
+  const int64_t nblk = d[0], m = d[1], n = d[2];
+  auto dx = x.dimensions();
+  if (dx.size() != 2 || dx[0] != nblk || dx[1] != n) {
+    return ffi::Error::InvalidArgument("X must be (nblk, n)");
+  }
+  return FusedNormal(a.typed_data(), x.typed_data(), u->typed_data(),
+                     q->typed_data(), nblk, m, n);
+}
+
+}  // namespace
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    FusedNormalF32, FusedNormalDispatch<ffi::F32>,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Arg<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::F32>>()
+        .Ret<ffi::Buffer<ffi::F32>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    FusedNormalF64, FusedNormalDispatch<ffi::F64>,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::F64>>()
+        .Arg<ffi::Buffer<ffi::F64>>()
+        .Ret<ffi::Buffer<ffi::F64>>()
+        .Ret<ffi::Buffer<ffi::F64>>());

@@ -183,6 +183,35 @@ def _twiddle_np(n1: int, n2: int, sign: float, dtype: str) -> np.ndarray:
                   * np.outer(np.arange(n1), np.arange(n2)) / n).astype(dtype)
 
 
+def stage_radices(n: int) -> list:
+    """The radix of each mixed-radix stage the engine will run for a
+    length-``n`` transform (diagnostic; Bluestein sizes report the
+    radices of their power-of-two convolution length). Total GEMM work
+    per transformed element is ``sum(stage_radices(n))`` complex MACs —
+    the engine's flop multiple over the O(n log n) FFT convention,
+    which bench rows use to convert measured time into real GEMM
+    GFLOP/s (and MFU on TPU)."""
+    base = _gemm_base()
+    out = []
+    m = n
+    while m > 1:
+        if m <= base:
+            out.append(m)
+            break
+        d = _best_split(m)
+        if d == 1:  # prime > base: Bluestein over next pow2 >= 2n-1
+            mm = 1
+            while mm < 2 * m - 1:
+                mm *= 2
+            # TWO on-device transforms of length mm (forward + inverse
+            # of the chirp product); the kernel spectrum is a host-side
+            # compile-time constant (_bluestein_consts), not GEMM work
+            return out + 2 * stage_radices(mm)
+        out.append(d)
+        m //= d
+    return out
+
+
 def _best_split(n: int) -> int:
     """Largest divisor of ``n`` that is ≤ the GEMM base (1 if prime).
     Direct divisor search (≤ base trial divisions) — greedy

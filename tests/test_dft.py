@@ -144,3 +144,58 @@ def test_x64_precision(monkeypatch):
     x = (rng.standard_normal((2, 192))
          + 1j * rng.standard_normal((2, 192))).astype(np.complex128)
     assert _rel(dft.fft(jnp.asarray(x)), np.fft.fft(x)) < 1e-12
+
+
+def test_gemm_base_platform_default(monkeypatch):
+    """The mixed-radix base resolves per platform (128 on TPU for the
+    MXU tile, 16 elsewhere) and obeys the env override."""
+    monkeypatch.delenv("PYLOPS_MPI_TPU_DFT_BASE", raising=False)
+    dft._base_cache = None
+    assert dft._gemm_base() == 16  # tests run on the CPU backend
+    monkeypatch.setenv("PYLOPS_MPI_TPU_DFT_BASE", "64")
+    dft._base_cache = None
+    assert dft._gemm_base() == 64
+    assert dft._best_split(1024) == 64
+
+
+def test_stage_radices_accounting(monkeypatch):
+    """stage_radices is the engine's work model: products must
+    reconstruct the length, Bluestein sizes report 3 transforms of the
+    pow2 convolution length, and the base caps every radix."""
+    monkeypatch.delenv("PYLOPS_MPI_TPU_DFT_BASE", raising=False)
+    dft._base_cache = None
+    base = dft._gemm_base()
+    for n in (8, 100, 128, 1000, 1024):
+        rs = dft.stage_radices(n)
+        assert int(np.prod(rs)) == n, (n, rs)
+        assert all(r <= base for r in rs)
+    # prime beyond the base: 2 on-device pow2 transforms of m >= 2n-1
+    # (the chirp kernel's spectrum is precomputed on the host)
+    rs = dft.stage_radices(263)
+    m = 1
+    while m < 2 * 263 - 1:
+        m *= 2
+    assert len(rs) == 2 * len(dft.stage_radices(m))
+
+
+def test_packed_rfft_matches_numpy_all_norms(monkeypatch):
+    """The packed-real path (even n) across every norm, plus the odd-n
+    fallback and n-argument pad/truncate."""
+    _force_matmul(monkeypatch)
+    rng = np.random.default_rng(11)
+    for n in (10, 96, 101):
+        x = rng.standard_normal((3, n))
+        for norm in (None, "ortho", "forward"):
+            got = np.asarray(dft.rfft(jnp.asarray(x), norm=norm))
+            assert _rel(got, np.fft.rfft(x, norm=norm)) < 1e-10
+            X = np.fft.rfft(x, norm=norm)
+            got = np.asarray(dft.irfft(jnp.asarray(X), norm=norm))
+            # numpy irfft defaults to n=2*(nh-1) (even) — compare there
+            assert _rel(got, np.fft.irfft(X, norm=norm)) < 1e-10
+    # pad + truncate through the packed path
+    x = rng.standard_normal((2, 10))
+    assert _rel(np.asarray(dft.rfft(jnp.asarray(x), n=16)),
+                np.fft.rfft(x, n=16)) < 1e-10
+    X = np.fft.rfft(rng.standard_normal((2, 24)))
+    assert _rel(np.asarray(dft.irfft(jnp.asarray(X), n=16)),
+                np.fft.irfft(X, n=16)) < 1e-10
