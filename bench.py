@@ -423,13 +423,13 @@ def child_main():
     f32_spread = getattr(measure, "last_spread_pct", None)
     f32_mode = "f32 two-sweep"
     f32_race = None
-    # On a single CPU device, race the native one-pass normal kernel
-    # (XLA-FFI, native/ffi.py): one DRAM sweep of the blocks per
-    # iteration vs the two-sweep's two — the configuration where the
-    # framework can legitimately beat the NumPy stand-in (round-4
-    # VERDICT next #2). Only at n_dev == 1: on the virtual 8-device
-    # mesh the per-shard thread pools oversubscribe one socket.
-    if (not on_tpu and n_dev == 1
+    # On CPU, race the native one-pass normal kernel (XLA-FFI,
+    # native/ffi.py): one DRAM sweep of the blocks per iteration vs
+    # the two-sweep's two — the schedule that beats the NumPy stand-in
+    # (round-4 VERDICT next #2). Works on the virtual multi-device
+    # mesh too: ffi.py caps per-shard threads so concurrent shard
+    # calls share the socket instead of oversubscribing it.
+    if (not on_tpu
             and os.environ.get("BENCH_F32_NORMAL_PYLOPS_MPI_TPU",
                                "1") != "0"):
         _progress("headline f32 fused-normal (native one-pass, race)")

@@ -102,6 +102,18 @@ def available() -> bool:
             import jax
             if _enabled() and jax.default_backend() == "cpu":
                 ok = _build_and_register()
+                # default thread budget: the kernel runs once PER SHARD
+                # inside shard_map, and on a virtual multi-device CPU
+                # mesh those calls are concurrent — splitting the
+                # socket's cores between them avoids oversubscription.
+                # Uses the kernel-specific PYLOPS_MPI_TPU_FFI_THREADS
+                # (explicit setting always wins); the shared
+                # PYLOPS_MPI_TPU_NATIVE_THREADS knob of the pack/IO
+                # helpers is deliberately left alone
+                if ok and "PYLOPS_MPI_TPU_FFI_THREADS" not in os.environ:
+                    ndev = max(1, len(jax.local_devices()))
+                    os.environ["PYLOPS_MPI_TPU_FFI_THREADS"] = str(
+                        max(1, (os.cpu_count() or 1) // ndev))
         except Exception as e:  # no g++, missing headers, …
             warnings.warn(f"pylops_mpi_tpu: native fused-normal FFI "
                           f"unavailable ({e!r}); using the two-sweep "

@@ -34,7 +34,11 @@ namespace {
 
 int NumThreads(int64_t rows_total) {
   long hw = static_cast<long>(std::thread::hardware_concurrency());
-  if (const char* env = std::getenv("PYLOPS_MPI_TPU_NATIVE_THREADS")) {
+  // kernel-specific knob — deliberately NOT the shared
+  // PYLOPS_MPI_TPU_NATIVE_THREADS that tunes the host pack/IO
+  // helpers: this kernel runs once per shard_map shard and its budget
+  // is per-shard, while the helpers' budget is per-process
+  if (const char* env = std::getenv("PYLOPS_MPI_TPU_FFI_THREADS")) {
     long v = std::strtol(env, nullptr, 10);
     if (v > 0) hw = v;
   }

@@ -255,10 +255,11 @@ def test_ffi_fused_normal_oracle(rng, dtype, shape):
 
 
 def test_ffi_fused_normal_single_thread_env(rng, monkeypatch):
-    """PYLOPS_MPI_TPU_NATIVE_THREADS=1 exercises the no-spawn path."""
+    """PYLOPS_MPI_TPU_FFI_THREADS=1 exercises the no-spawn path (the
+    kernel-specific knob, distinct from the pack/IO helpers')."""
     nffi = _ffi()
     import jax.numpy as jnp
-    monkeypatch.setenv("PYLOPS_MPI_TPU_NATIVE_THREADS", "1")
+    monkeypatch.setenv("PYLOPS_MPI_TPU_FFI_THREADS", "1")
     A = jnp.asarray(rng.standard_normal((2, 96, 32)).astype(np.float32))
     X = jnp.asarray(rng.standard_normal((2, 32)).astype(np.float32))
     U, Q = nffi.fused_normal(A, X)
