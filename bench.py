@@ -917,7 +917,12 @@ def _emit_final(result):
             json.dump(result, f, indent=1)
     except Exception:
         pass  # detail file is best-effort; the summary line is not
+    print(json.dumps(_compact_line(result)))
 
+
+def _compact_line(result):
+    """The ≤2 KB summary dict for one stdout line (shared by the final
+    emit and the pre-1-dev-child partial banking in main())."""
     sc = result.get("selfcheck") or {}
     checks = sc.get("checks") or {}
     comps = [c for c in (result.get("components") or [])
@@ -990,7 +995,7 @@ def _emit_final(result):
         compact.pop(victim, None)
     if len(json.dumps(compact)) > 2000:
         compact["metric"] = compact.get("metric", "")[:120]
-    print(json.dumps(compact))
+    return compact
 
 
 def main():
@@ -1031,6 +1036,19 @@ def main():
             # BEFORE cache promotion: round 4 returned early on a
             # banked TPU entry and the row was silently absent from
             # the artifact.
+            # bank what we already have BEFORE the extra child: the
+            # driver takes the LAST stdout JSON line, so if an outer
+            # wall budget kills this parent mid-1-dev-run, the full
+            # degraded artifact (merged with any TPU cache) still
+            # stands instead of parsed-null
+            try:
+                early = _merge_tpu_cache(dict(result))
+                early["partial"] = "flagship_1dev_cpu pending"
+                # the partial flag rides INSIDE the compact builder so
+                # its ≤2KB shedding accounts for it
+                print(json.dumps(_compact_line(early)), flush=True)
+            except Exception:
+                pass
             env1 = dict(os.environ)
             env1["JAX_PLATFORMS"] = "cpu"
             env1["BENCH_FORCE_CPU"] = "1"

@@ -42,10 +42,14 @@ _state: Optional[bool] = None  # None = not tried; True/False = usable
 _TARGETS = {
     np.dtype(np.float32): "pylops_mpi_tpu_fused_normal_f32",
     np.dtype(np.float64): "pylops_mpi_tpu_fused_normal_f64",
+    np.dtype(np.complex64): "pylops_mpi_tpu_fused_normal_c64",
+    np.dtype(np.complex128): "pylops_mpi_tpu_fused_normal_c128",
 }
 _SYMBOLS = {
     np.dtype(np.float32): "FusedNormalF32",
     np.dtype(np.float64): "FusedNormalF64",
+    np.dtype(np.complex64): "FusedNormalC64",
+    np.dtype(np.complex128): "FusedNormalC128",
 }
 
 
@@ -123,10 +127,19 @@ def available() -> bool:
         return ok
 
 
+def supports(dtype) -> bool:
+    """True when the kernel has a handler for ``dtype`` (f32/f64 plus
+    c64/c128 with adjoint-side conjugation). The single owner of the
+    dtype contract — callers must not reach into ``_TARGETS``."""
+    return np.dtype(dtype) in _TARGETS
+
+
 def fused_normal(A, X):
-    """``(U, Q) = (AᴴA x, A x)`` for real ``A (nblk, m, n)``,
-    ``X (nblk, n)`` via the one-pass native kernel. Caller must check
-    :func:`available` first and pass matching real dtypes."""
+    """``(U, Q) = (AᴴA x, A x)`` for ``A (nblk, m, n)``,
+    ``X (nblk, n)`` via the one-pass native kernel — any dtype
+    :func:`supports` accepts (real f32/f64, complex c64/c128; the
+    adjoint side conjugates). Caller must check :func:`available`
+    first and pass A and X at the SAME dtype."""
     import jax
     import jax.ffi
 

@@ -20,6 +20,7 @@
 // (pylops_mpi/optimization/cls_basic.py:370-404).
 
 #include <algorithm>
+#include <complex>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -31,6 +32,13 @@
 namespace ffi = xla::ffi;
 
 namespace {
+
+// adjoint-side conjugation: identity for real T, conj for complex —
+// q = A x uses the plain product, u = Aᴴ q conjugates the row
+template <typename T>
+inline T Cj(T v) { return v; }
+template <typename U>
+inline std::complex<U> Cj(std::complex<U> v) { return std::conj(v); }
 
 int NumThreads(int64_t rows_total) {
   long hw = static_cast<long>(std::thread::hardware_concurrency());
@@ -71,7 +79,7 @@ void SlabWorker(const T* A, const T* X, T* Q, T* acc, int64_t nblk,
       for (int k = 0; k < 16; ++k) s += p[k];
       for (; j < n; ++j) s += row[j] * xb[j];
       qb[r] = s;
-      for (int64_t k = 0; k < n; ++k) ub[k] += s * row[k];
+      for (int64_t k = 0; k < n; ++k) ub[k] += s * Cj(row[k]);
     }
   }
 }
@@ -142,3 +150,19 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
         .Arg<ffi::Buffer<ffi::F64>>()
         .Ret<ffi::Buffer<ffi::F64>>()
         .Ret<ffi::Buffer<ffi::F64>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    FusedNormalC64, FusedNormalDispatch<ffi::C64>,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::C64>>()
+        .Arg<ffi::Buffer<ffi::C64>>()
+        .Ret<ffi::Buffer<ffi::C64>>()
+        .Ret<ffi::Buffer<ffi::C64>>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    FusedNormalC128, FusedNormalDispatch<ffi::C128>,
+    ffi::Ffi::Bind()
+        .Arg<ffi::Buffer<ffi::C128>>()
+        .Arg<ffi::Buffer<ffi::C128>>()
+        .Ret<ffi::Buffer<ffi::C128>>()
+        .Ret<ffi::Buffer<ffi::C128>>());

@@ -15,6 +15,7 @@ of P small ones.
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -152,14 +153,23 @@ class MPIBlockDiag(MPILinearOperator):
 
     def _ffi_normal_usable(self) -> bool:
         # CPU backends run the native one-pass XLA-FFI kernel
-        # (native/ffi.py) — Pallas-interpret would be a perf trap there
+        # (native/ffi.py) — Pallas-interpret would be a perf trap
+        # there. Real dtypes by default; the kernel also implements
+        # complex blocks (MDD-style per-frequency solves,
+        # ``u = Aᴴ(Ax)`` with adjoint-side conjugation) but scalar
+        # std::complex math measures 0.42x the sharded XLA two-sweep
+        # (compute-bound, round 5) — complex stays OPT-IN via
+        # PYLOPS_MPI_TPU_FFI_COMPLEX=1 until the kernel vectorises.
         import jax as _jax
         if _jax.default_backend() != "cpu":
             return False
-        if np.dtype(self._batched.dtype) not in (np.dtype(np.float32),
-                                                 np.dtype(np.float64)):
-            return False
         from ..native import ffi as nffi
+        dt = np.dtype(self._batched.dtype)
+        if not nffi.supports(dt):
+            return False
+        if (np.issubdtype(dt, np.complexfloating)
+                and os.environ.get("PYLOPS_MPI_TPU_FFI_COMPLEX") != "1"):
+            return False
         return nffi.available()
 
     @property
@@ -180,20 +190,21 @@ class MPIBlockDiag(MPILinearOperator):
         does the same against DRAM (measured 1.6x the two-sweep
         einsum pair at the 4096² flagship block). Falls back to
         matvec+rmatvec otherwise."""
-        if not self.has_fused_normal \
-                or jnp.issubdtype(x.dtype, jnp.complexfloating):
-            # complex vectors would be silently truncated by the real
-            # kernel — use the generic two-sweep pair
+        if not self.has_fused_normal:
             return super().normal_matvec(x)
         from jax.sharding import PartitionSpec as P
         from jax import shard_map
         from .pallas_kernels import normal_matvec_supported
         if self._ffi_normal_usable() \
                 and np.dtype(x.dtype) == np.dtype(self._batched.dtype):
+            # the native kernel handles real AND complex blocks
             from ..native.ffi import fused_normal as kernel
-        elif normal_matvec_supported(self._batched):
+        elif (normal_matvec_supported(self._batched)
+              and not jnp.issubdtype(x.dtype, jnp.complexfloating)):
+            # complex vectors would be silently truncated by the real
+            # Pallas kernel — only the real path may use it
             from .pallas_kernels import batched_normal_matvec as kernel
-        else:  # e.g. FFI-eligible operator fed a mismatched-dtype x
+        else:  # mismatched-dtype x, or complex without the FFI kernel
             return super().normal_matvec(x)
         A = self._batched
         nblk, m, n = A.shape

@@ -311,3 +311,47 @@ def test_cgls_normal_matches_two_sweep_cpu(rng):
     assert np.linalg.norm(xa.asarray() - xt) / np.linalg.norm(xt) < 1e-4
     np.testing.assert_allclose(xa.asarray(), xb.asarray(), rtol=1e-3,
                                atol=1e-4)
+
+
+@pytest.mark.parametrize("dtype", [np.complex64, np.complex128])
+def test_ffi_fused_normal_complex_oracle(rng, dtype):
+    """Complex one-pass (AᴴAx, Ax): the adjoint side conjugates, the
+    forward side does not."""
+    nffi = _ffi()
+    import jax.numpy as jnp
+    A = jnp.asarray((rng.standard_normal((2, 40, 56))
+                     + 1j * rng.standard_normal((2, 40, 56))).astype(dtype))
+    X = jnp.asarray((rng.standard_normal((2, 56))
+                     + 1j * rng.standard_normal((2, 56))).astype(dtype))
+    U, Q = jax.jit(nffi.fused_normal)(A, X)
+    wq = np.einsum("bmn,bn->bm", np.asarray(A), np.asarray(X))
+    wu = np.einsum("bmn,bm->bn", np.asarray(A).conj(), wq)
+    tol = 1e-5 if dtype == np.complex64 else 1e-12
+    assert np.linalg.norm(Q - wq) / np.linalg.norm(wq) < tol
+    assert np.linalg.norm(U - wu) / np.linalg.norm(wu) < tol
+
+
+def test_blockdiag_complex_ffi_opt_in(rng, monkeypatch):
+    """Complex blocks use the FFI kernel only with
+    PYLOPS_MPI_TPU_FFI_COMPLEX=1 (scalar complex math measured slower
+    than the XLA two-sweep — docs/design.md round-5 findings); default
+    falls back to the generic pair, opt-in must match it."""
+    _ffi()
+    from pylops_mpi_tpu import MPIBlockDiag, cgls
+    from pylops_mpi_tpu.ops.local import MatrixMult
+    nb = 16
+    blocks = []
+    for _ in range(8):
+        b = (rng.standard_normal((nb, nb))
+             + 1j * rng.standard_normal((nb, nb))) / np.sqrt(nb)
+        b += 4.0 * np.eye(nb)
+        blocks.append(b.astype(np.complex128))
+    Op = MPIBlockDiag([MatrixMult(b) for b in blocks])
+    monkeypatch.delenv("PYLOPS_MPI_TPU_FFI_COMPLEX", raising=False)
+    assert not Op._ffi_normal_usable()          # default: opt-out
+    monkeypatch.setenv("PYLOPS_MPI_TPU_FFI_COMPLEX", "1")
+    assert Op._ffi_normal_usable() and Op.has_fused_normal
+    xt = rng.standard_normal(8 * nb) + 1j * rng.standard_normal(8 * nb)
+    y = Op.matvec(DistributedArray.to_dist(xt))
+    xa, *_ = cgls(Op, y, niter=60, tol=0.0, normal=True)
+    assert np.linalg.norm(xa.asarray() - xt) / np.linalg.norm(xt) < 1e-10
