@@ -33,12 +33,42 @@ namespace ffi = xla::ffi;
 
 namespace {
 
-// adjoint-side conjugation: identity for real T, conj for complex —
-// q = A x uses the plain product, u = Aᴴ q conjugates the row
-template <typename T>
-inline T Cj(T v) { return v; }
-template <typename U>
-inline std::complex<U> Cj(std::complex<U> v) { return std::conj(v); }
+int NumThreads(int64_t rows_total);
+
+// Shared thread orchestration for both element kinds: slab-partition
+// rows [0, m) across threads, give each a private zeroed accumulator
+// of acc_len scalars, join, then merge in fixed thread order (the
+// deterministic reduction both kernels rely on). work(acc, r0, r1)
+// must write only its own rows of Q and only its private acc.
+template <typename U, typename W>
+ffi::Error RunSlabs(W&& work, U* Uo, int64_t acc_len, int64_t m) {
+  const int nt = NumThreads(m);
+  if (nt <= 1) {
+    std::memset(Uo, 0, sizeof(U) * acc_len);
+    work(Uo, int64_t{0}, m);
+    return ffi::Error::Success();
+  }
+  std::vector<std::vector<U>> accs(nt);
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  const int64_t slab = (m + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    accs[t].assign(static_cast<size_t>(acc_len), U(0));
+    const int64_t r0 = t * slab;
+    const int64_t r1 = std::min<int64_t>(m, r0 + slab);
+    if (r0 >= r1) continue;
+    threads.emplace_back(
+        [&work, &accs, t, r0, r1] { work(accs[t].data(), r0, r1); });
+  }
+  for (auto& th : threads) th.join();
+  std::memset(Uo, 0, sizeof(U) * acc_len);
+  for (int t = 0; t < nt; ++t) {
+    if (accs[t].empty()) continue;
+    const U* a = accs[t].data();
+    for (int64_t k = 0; k < acc_len; ++k) Uo[k] += a[k];
+  }
+  return ffi::Error::Success();
+}
 
 int NumThreads(int64_t rows_total) {
   long hw = static_cast<long>(std::thread::hardware_concurrency());
@@ -79,41 +109,111 @@ void SlabWorker(const T* A, const T* X, T* Q, T* acc, int64_t nblk,
       for (int k = 0; k < 16; ++k) s += p[k];
       for (; j < n; ++j) s += row[j] * xb[j];
       qb[r] = s;
-      for (int64_t k = 0; k < n; ++k) ub[k] += s * Cj(row[k]);
+      // real-only kernel: Aᵀ needs no conjugation (complex blocks
+      // route to SlabWorkerCplx, never here)
+      for (int64_t k = 0; k < n; ++k) ub[k] += s * row[k];
     }
   }
+}
+
+// Complex slab worker on reinterpreted real buffers (std::complex<U>
+// guarantees interleaved re,im). Scalar std::complex math measured
+// 0.42x the XLA two-sweep (compute-bound); instead the complex dot is
+// TWO plain real dots of the interleaved row against precomputed
+// auxiliary vectors —
+//   s_re = <row_f, xa>,  xa = [br0, -bi0, br1, -bi1, …]
+//   s_im = <row_f, xb>,  xb = [bi0,  br0, bi1,  br1, …]
+// — which the compiler vectorises like the real kernel, and the
+// conjugated axpy u += s·conj(row) is the pairwise form below.
+template <typename U>
+void SlabWorkerCplx(const U* A, const U* XA, const U* XB, U* Q, U* acc,
+                    int64_t nblk, int64_t m, int64_t n, int64_t r0,
+                    int64_t r1) {
+  const int64_t n2 = 2 * n;
+  for (int64_t b = 0; b < nblk; ++b) {
+    const U* Ab = A + b * m * n2;
+    const U* xa = XA + b * n2;
+    const U* xb = XB + b * n2;
+    U* qb = Q + b * m * 2;
+    U* ub = acc + b * n2;
+    for (int64_t r = r0; r < r1; ++r) {
+      const U* row = Ab + r * n2;
+      U pa[16] = {0}, pb[16] = {0};
+      int64_t j = 0;
+      for (; j + 16 <= n2; j += 16) {
+        for (int k = 0; k < 16; ++k) {
+          pa[k] += row[j + k] * xa[j + k];
+          pb[k] += row[j + k] * xb[j + k];
+        }
+      }
+      U sre = 0, sim = 0;
+      for (int k = 0; k < 16; ++k) { sre += pa[k]; sim += pb[k]; }
+      for (; j < n2; ++j) { sre += row[j] * xa[j]; sim += row[j] * xb[j]; }
+      qb[2 * r] = sre;
+      qb[2 * r + 1] = sim;
+      // u += s * conj(row):  re += sre*ar + sim*ai, im += sim*ar - sre*ai
+      for (int64_t k = 0; k < n; ++k) {
+        const U ar = row[2 * k], ai = row[2 * k + 1];
+        ub[2 * k] += sre * ar + sim * ai;
+        ub[2 * k + 1] += sim * ar - sre * ai;
+      }
+    }
+  }
+}
+
+template <typename U>
+ffi::Error FusedNormalCplx(const std::complex<U>* Ac,
+                           const std::complex<U>* Xc, std::complex<U>* Uc,
+                           std::complex<U>* Qc, int64_t nblk, int64_t m,
+                           int64_t n) {
+  const U* A = reinterpret_cast<const U*>(Ac);
+  U* Uo = reinterpret_cast<U*>(Uc);
+  U* Q = reinterpret_cast<U*>(Qc);
+  // auxiliary re/im mixing vectors, once per call (2·nblk·n U each)
+  std::vector<U> XA(static_cast<size_t>(nblk * 2 * n));
+  std::vector<U> XB(static_cast<size_t>(nblk * 2 * n));
+  for (int64_t b = 0; b < nblk; ++b) {
+    const std::complex<U>* xb_ = Xc + b * n;
+    U* xa = XA.data() + b * 2 * n;
+    U* xb = XB.data() + b * 2 * n;
+    for (int64_t jj = 0; jj < n; ++jj) {
+      xa[2 * jj] = xb_[jj].real();
+      xa[2 * jj + 1] = -xb_[jj].imag();
+      xb[2 * jj] = xb_[jj].imag();
+      xb[2 * jj + 1] = xb_[jj].real();
+    }
+  }
+  return RunSlabs<U>(
+      [&](U* acc, int64_t r0, int64_t r1) {
+        SlabWorkerCplx<U>(A, XA.data(), XB.data(), Q, acc, nblk, m, n,
+                          r0, r1);
+      },
+      Uo, nblk * 2 * n, m);
 }
 
 template <typename T>
 ffi::Error FusedNormal(const T* A, const T* X, T* U, T* Q, int64_t nblk,
                        int64_t m, int64_t n) {
-  const int nt = NumThreads(m);
-  if (nt <= 1) {
-    std::memset(U, 0, sizeof(T) * nblk * n);
-    SlabWorker<T>(A, X, Q, U, nblk, m, n, 0, m);
-    return ffi::Error::Success();
-  }
-  std::vector<std::vector<T>> accs(nt);
-  std::vector<std::thread> threads;
-  threads.reserve(nt);
-  const int64_t slab = (m + nt - 1) / nt;
-  for (int t = 0; t < nt; ++t) {
-    accs[t].assign(static_cast<size_t>(nblk * n), T(0));
-    const int64_t r0 = t * slab;
-    const int64_t r1 = std::min<int64_t>(m, r0 + slab);
-    if (r0 >= r1) continue;
-    threads.emplace_back(SlabWorker<T>, A, X, Q, accs[t].data(), nblk, m,
-                         n, r0, r1);
-  }
-  for (auto& th : threads) th.join();
-  // deterministic tree-free reduction in fixed thread order
-  std::memset(U, 0, sizeof(T) * nblk * n);
-  for (int t = 0; t < nt; ++t) {
-    if (accs[t].empty()) continue;
-    const T* a = accs[t].data();
-    for (int64_t k = 0; k < nblk * n; ++k) U[k] += a[k];
-  }
-  return ffi::Error::Success();
+  return RunSlabs<T>(
+      [&](T* acc, int64_t r0, int64_t r1) {
+        SlabWorker<T>(A, X, Q, acc, nblk, m, n, r0, r1);
+      },
+      U, nblk * n, m);
+}
+
+// route by element type: complex goes to the planar-trick worker
+template <typename U>
+ffi::Error FusedNormalRoute(const std::complex<U>* A,
+                            const std::complex<U>* X, std::complex<U>* Uo,
+                            std::complex<U>* Q, int64_t nblk, int64_t m,
+                            int64_t n) {
+  return FusedNormalCplx<U>(A, X, Uo, Q, nblk, m, n);
+}
+
+template <typename T>
+ffi::Error FusedNormalRoute(const T* A, const T* X, T* Uo, T* Q,
+                            int64_t nblk, int64_t m, int64_t n) {
+  return FusedNormal<T>(A, X, Uo, Q, nblk, m, n);
 }
 
 template <ffi::DataType DT>
@@ -129,8 +229,8 @@ ffi::Error FusedNormalDispatch(ffi::Buffer<DT> a, ffi::Buffer<DT> x,
   if (dx.size() != 2 || dx[0] != nblk || dx[1] != n) {
     return ffi::Error::InvalidArgument("X must be (nblk, n)");
   }
-  return FusedNormal(a.typed_data(), x.typed_data(), u->typed_data(),
-                     q->typed_data(), nblk, m, n);
+  return FusedNormalRoute(a.typed_data(), x.typed_data(), u->typed_data(),
+                          q->typed_data(), nblk, m, n);
 }
 
 }  // namespace

@@ -154,12 +154,12 @@ class MPIBlockDiag(MPILinearOperator):
     def _ffi_normal_usable(self) -> bool:
         # CPU backends run the native one-pass XLA-FFI kernel
         # (native/ffi.py) — Pallas-interpret would be a perf trap
-        # there. Real dtypes by default; the kernel also implements
-        # complex blocks (MDD-style per-frequency solves,
-        # ``u = Aᴴ(Ax)`` with adjoint-side conjugation) but scalar
-        # std::complex math measures 0.42x the sharded XLA two-sweep
-        # (compute-bound, round 5) — complex stays OPT-IN via
-        # PYLOPS_MPI_TPU_FFI_COMPLEX=1 until the kernel vectorises.
+        # there. Complex blocks (MDD-style per-frequency solves,
+        # ``u = Aᴴ(Ax)`` with adjoint-side conjugation) are default-on
+        # since the planar rewrite: the complex dot runs as two real
+        # dots over the interleaved row, measured 4.9× the XLA
+        # two-sweep on one device and ≥1.0× on the sharded sim mesh
+        # (round 5). PYLOPS_MPI_TPU_FFI_COMPLEX=0 is the kill-switch.
         import jax as _jax
         if _jax.default_backend() != "cpu":
             return False
@@ -168,7 +168,7 @@ class MPIBlockDiag(MPILinearOperator):
         if not nffi.supports(dt):
             return False
         if (np.issubdtype(dt, np.complexfloating)
-                and os.environ.get("PYLOPS_MPI_TPU_FFI_COMPLEX") != "1"):
+                and os.environ.get("PYLOPS_MPI_TPU_FFI_COMPLEX") == "0"):
             return False
         return nffi.available()
 

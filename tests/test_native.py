@@ -331,11 +331,10 @@ def test_ffi_fused_normal_complex_oracle(rng, dtype):
     assert np.linalg.norm(U - wu) / np.linalg.norm(wu) < tol
 
 
-def test_blockdiag_complex_ffi_opt_in(rng, monkeypatch):
-    """Complex blocks use the FFI kernel only with
-    PYLOPS_MPI_TPU_FFI_COMPLEX=1 (scalar complex math measured slower
-    than the XLA two-sweep — docs/design.md round-5 findings); default
-    falls back to the generic pair, opt-in must match it."""
+def test_blockdiag_complex_ffi_default_on(rng, monkeypatch):
+    """Complex blocks use the FFI kernel by default (planar rewrite,
+    docs/design.md round-5 findings); PYLOPS_MPI_TPU_FFI_COMPLEX=0 is
+    the kill-switch back to the generic pair."""
     _ffi()
     from pylops_mpi_tpu import MPIBlockDiag, cgls
     from pylops_mpi_tpu.ops.local import MatrixMult
@@ -348,9 +347,10 @@ def test_blockdiag_complex_ffi_opt_in(rng, monkeypatch):
         blocks.append(b.astype(np.complex128))
     Op = MPIBlockDiag([MatrixMult(b) for b in blocks])
     monkeypatch.delenv("PYLOPS_MPI_TPU_FFI_COMPLEX", raising=False)
-    assert not Op._ffi_normal_usable()          # default: opt-out
-    monkeypatch.setenv("PYLOPS_MPI_TPU_FFI_COMPLEX", "1")
     assert Op._ffi_normal_usable() and Op.has_fused_normal
+    monkeypatch.setenv("PYLOPS_MPI_TPU_FFI_COMPLEX", "0")
+    assert not Op._ffi_normal_usable()          # kill-switch
+    monkeypatch.delenv("PYLOPS_MPI_TPU_FFI_COMPLEX", raising=False)
     xt = rng.standard_normal(8 * nb) + 1j * rng.standard_normal(8 * nb)
     y = Op.matvec(DistributedArray.to_dist(xt))
     xa, *_ = cgls(Op, y, niter=60, tol=0.0, normal=True)
