@@ -161,7 +161,7 @@ class MPIBlockDiag(MPILinearOperator):
         # two-sweep on one device and ≥1.0× on the sharded sim mesh
         # (round 5). PYLOPS_MPI_TPU_FFI_COMPLEX=0 is the kill-switch.
         import jax as _jax
-        if _jax.default_backend() != "cpu":
+        if self._batched is None or _jax.default_backend() != "cpu":
             return False
         from ..native import ffi as nffi
         dt = np.dtype(self._batched.dtype)

@@ -269,15 +269,17 @@ def test_ffi_fused_normal_single_thread_env(rng, monkeypatch):
     assert np.linalg.norm(Q - wq) / np.linalg.norm(wq) < 1e-5
 
 
-def test_blockdiag_normal_matvec_uses_ffi_on_cpu(rng):
+def test_blockdiag_normal_matvec_uses_ffi_on_cpu(rng, ndev):
     """On CPU backends the batched BlockDiag normal product must route
     through the native one-pass kernel and agree with the generic
     two-sweep pair (the solver-facing contract of cgls(normal=True))."""
     _ffi()
     from pylops_mpi_tpu import MPIBlockDiag
     from pylops_mpi_tpu.ops.local import MatrixMult
+    # P blocks: the batched layout (and thus the kernel) needs
+    # nblocks % P == 0 at ANY test mesh size
     blocks = [rng.standard_normal((24, 24)).astype(np.float32)
-              for _ in range(8)]
+              for _ in range(ndev)]
     Op = MPIBlockDiag([MatrixMult(b, dtype=np.float32) for b in blocks])
     assert Op.has_fused_normal
     x = DistributedArray.to_dist(
@@ -291,20 +293,21 @@ def test_blockdiag_normal_matvec_uses_ffi_on_cpu(rng):
                                atol=2e-4)
 
 
-def test_cgls_normal_matches_two_sweep_cpu(rng):
+def test_cgls_normal_matches_two_sweep_cpu(rng, ndev):
     """cgls(normal=True) through the FFI kernel converges to the same
     solution as the two-sweep fused loop."""
     _ffi()
     from pylops_mpi_tpu import MPIBlockDiag, cgls
     from pylops_mpi_tpu.ops.local import MatrixMult
     n = 32
+    P = ndev
     blocks = []
-    for _ in range(8):
+    for _ in range(P):
         b = (rng.standard_normal((n, n)) / np.sqrt(n)).astype(np.float32)
         np.fill_diagonal(b, b.diagonal() + 4.0)
         blocks.append(b)
     Op = MPIBlockDiag([MatrixMult(b, dtype=np.float32) for b in blocks])
-    xt = rng.standard_normal(8 * n).astype(np.float32)
+    xt = rng.standard_normal(P * n).astype(np.float32)
     y = Op.matvec(DistributedArray.to_dist(xt))
     xa, *_ = cgls(Op, y, niter=50, tol=0.0, normal=True)
     xb, *_ = cgls(Op, y, niter=50, tol=0.0, normal=False)
@@ -331,7 +334,7 @@ def test_ffi_fused_normal_complex_oracle(rng, dtype):
     assert np.linalg.norm(U - wu) / np.linalg.norm(wu) < tol
 
 
-def test_blockdiag_complex_ffi_default_on(rng, monkeypatch):
+def test_blockdiag_complex_ffi_default_on(rng, monkeypatch, ndev):
     """Complex blocks use the FFI kernel by default (planar rewrite,
     docs/design.md round-5 findings); PYLOPS_MPI_TPU_FFI_COMPLEX=0 is
     the kill-switch back to the generic pair."""
@@ -339,8 +342,9 @@ def test_blockdiag_complex_ffi_default_on(rng, monkeypatch):
     from pylops_mpi_tpu import MPIBlockDiag, cgls
     from pylops_mpi_tpu.ops.local import MatrixMult
     nb = 16
+    P = ndev
     blocks = []
-    for _ in range(8):
+    for _ in range(P):
         b = (rng.standard_normal((nb, nb))
              + 1j * rng.standard_normal((nb, nb))) / np.sqrt(nb)
         b += 4.0 * np.eye(nb)
@@ -351,7 +355,7 @@ def test_blockdiag_complex_ffi_default_on(rng, monkeypatch):
     monkeypatch.setenv("PYLOPS_MPI_TPU_FFI_COMPLEX", "0")
     assert not Op._ffi_normal_usable()          # kill-switch
     monkeypatch.delenv("PYLOPS_MPI_TPU_FFI_COMPLEX", raising=False)
-    xt = rng.standard_normal(8 * nb) + 1j * rng.standard_normal(8 * nb)
+    xt = rng.standard_normal(P * nb) + 1j * rng.standard_normal(P * nb)
     y = Op.matvec(DistributedArray.to_dist(xt))
     xa, *_ = cgls(Op, y, niter=60, tol=0.0, normal=True)
     assert np.linalg.norm(xa.asarray() - xt) / np.linalg.norm(xt) < 1e-10
