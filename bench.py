@@ -888,6 +888,22 @@ def _merge_tpu_cache(result, root=None):
     if r and r.get("platform") == "tpu" and "tpu_breakdown" not in result:
         result["tpu_breakdown"] = {**r, "cached": True,
                                    "ts": ent.get("ts")}
+    ent = cache.get("bisect") or {}
+    r = ent.get("result")
+    if r and isinstance(r.get("results"), dict):
+        probes = r["results"]
+        plats = {v.get("platform") for v in probes.values()
+                 if isinstance(v, dict)} - {None}
+        # same hardware-evidence rule as the selfcheck/diag merges: a
+        # rehearsal bisect (cpu children) proves nothing about the chip
+        if plats == {"tpu"}:
+            result["tpu_bisect"] = {
+                "ts": ent.get("ts"), "code_rev": ent.get("code_rev"),
+                "probes": {k: {"ok": v.get("ok"),
+                               **({"error": v.get("error")}
+                                  if v.get("error") else {})}
+                           for k, v in probes.items()
+                           if isinstance(v, dict)}}
     ent = cache.get("diag") or {}
     r = ent.get("result")
     # same hardware-evidence rule as the selfcheck merge above: a diag

@@ -67,6 +67,14 @@ def main() -> None:
     reps = int(os.environ.get("BREAKDOWN_REPS", "7"))
     out = {"platform": platform, "n_devices": n_dev, "nblock": nblock}
 
+    def bank():
+        """Emit the dict-so-far as a flushed partial line: the round-5
+        TPU window timed this stage out at 900 s (12 tunnel compiles)
+        with NOTHING on stdout — _run_json_cmd salvages the LAST JSON
+        line, so each section banks its results the moment they
+        exist."""
+        print(json.dumps({**out, "partial": True}), flush=True)
+
     def best(f, r=reps):
         f()  # warmup/compile
         dt = float("inf")
@@ -81,6 +89,7 @@ def main() -> None:
     noop = jax.jit(lambda v: v + 1.0)
     out["dispatch_ms"] = round(
         best(lambda: jax.block_until_ready(noop(one))) * 1e3, 3)
+    bank()
 
     # 2. the flagship operator at this size
     blocks_np, xtrue, y_np = bench.make_problem(nblk, nblock, seed=0)
@@ -97,6 +106,7 @@ def main() -> None:
     sweep = jax.jit(lambda v: Op.rmatvec(Op.matvec(v))._arr)
     t_sweep = best(lambda: jax.block_until_ready(sweep(dx := dy)))
     out["sweep_ms"] = round(t_sweep * 1e3, 3)
+    bank()
 
     # 3. fixed-vs-marginal fit over niter
     niters = [int(v) for v in os.environ.get(
@@ -107,6 +117,8 @@ def main() -> None:
                      _cgls_fused(Op, y, x, _n, damp, tol))
         t = best(lambda: jax.block_until_ready(fn(dy, x0, 0.0, 0.0)[0]._arr))
         points.append({"niter": nit, "ms": round(t * 1e3, 3)})
+        out["niter_points_partial"] = points
+        bank()
     ns = np.array([p["niter"] for p in points], dtype=float)
     ts = np.array([p["ms"] for p in points], dtype=float) / 1e3
     A = np.stack([np.ones_like(ns), ns], axis=1)
@@ -126,6 +138,8 @@ def main() -> None:
     # one standalone matvec+rmatvec sweep (plus small reduction work)
     out["while_loop_marginal_vs_sweep"] = (
         round(float(per_iter) / t_sweep, 2) if t_sweep > 0 else None)
+    out.pop("niter_points_partial", None)
+    bank()
 
     # 3b. the same fit for a reduction-free loop (two operator sweeps
     # per iteration, NO dots/norms/cost history): separates GEMV time
@@ -142,6 +156,8 @@ def main() -> None:
         fn = jax.jit(lambda v, _n=nit: _sweeps_only(v, _n)._arr)
         t = best(lambda: jax.block_until_ready(fn(x0)))
         pts2.append({"niter": nit, "ms": round(t * 1e3, 3)})
+        out["sweeps_only_points_partial"] = pts2
+        bank()
     ts2 = np.array([p["ms"] for p in pts2], dtype=float) / 1e3
     (fixed2, per_iter2), *_ = np.linalg.lstsq(A, ts2, rcond=None)
     out["sweeps_only_fit"] = {
@@ -150,6 +166,8 @@ def main() -> None:
     if per_iter2 > 0:
         out["reduction_overhead_per_iter_ms"] = round(
             float(per_iter - per_iter2) * 1e3, 4)
+    out.pop("sweeps_only_points_partial", None)
+    bank()
 
     # 4. XLA's own estimate for the 60-iter solve
     try:
@@ -165,6 +183,7 @@ def main() -> None:
         out["cost_analysis"] = keep or None
     except Exception as e:
         out["cost_analysis"] = {"error": repr(e)[:200]}
+    bank()
 
     # 5. expected memory-bound per-iter time at the quoted HBM bandwidth,
     # for the artifact to carry its own roofline context
@@ -194,7 +213,7 @@ def main() -> None:
         except Exception as e:
             out["profile_trace"] = {"error": repr(e)[:200]}
 
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

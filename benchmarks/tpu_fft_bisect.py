@@ -1,0 +1,242 @@
+"""Bisect the pencil_fft2d UNIMPLEMENTED failure on the axon runtime.
+
+The round-5 hardware selfcheck (the first ever to run) showed every
+real-valued kernel green and every complex-valued check dead with
+``UNIMPLEMENTED: TPU backend error`` — including the matmul-DFT
+engine, which was built precisely to avoid the missing fft
+custom-call. The suspect list, orthogonalised:
+
+1. complex64 constants / elementwise math on device
+2. complex64 GEMM (jnp.matmul and the engine's exact einsum form)
+3. planar complex GEMM — 3 real GEMMs on (re, im) pairs (the
+   candidate fix: if this passes while 1-2 fail, the runtime has no
+   complex support at all and the FFT stack needs a planar mode)
+4. all_to_all / shard_map on the 1-device mesh (the pencil path)
+5. the matmul-DFT 1-D transform itself
+6. the full MPIFFT2D pencil check that failed
+
+One child process per probe: the first UNIMPLEMENTED wedges the PJRT
+client (proved by the post_fft_canary), so in-process sequencing
+would mask every later probe. Run while the tunnel is live:
+
+    python benchmarks/tpu_fft_bisect.py [--timeout 180]
+
+Prints one JSON line per probe and a final summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+
+PROBES = {
+    # name -> python source run in a fresh child (must print one JSON
+    # line {"ok": bool, ...}); keep each minimal and independent
+    "complex_const_add": """
+import jax.numpy as jnp, numpy as np
+z = jnp.asarray(np.array([1+2j, 3-1j], np.complex64))
+w = (z + z * (2-1j)).block_until_ready()
+err = abs(np.asarray(w) - (np.array([1+2j,3-1j])*(3-1j))).max()
+print_result(ok=bool(err < 1e-5), err=float(err))
+""",
+    "complex_matmul": """
+import jax.numpy as jnp, numpy as np
+rng = np.random.default_rng(0)
+a = (rng.standard_normal((8,8)) + 1j*rng.standard_normal((8,8))).astype(np.complex64)
+b = (rng.standard_normal((8,8)) + 1j*rng.standard_normal((8,8))).astype(np.complex64)
+got = np.asarray((jnp.asarray(a) @ jnp.asarray(b)).block_until_ready())
+err = np.abs(got - a @ b).max()
+print_result(ok=bool(err < 1e-3), err=float(err))
+""",
+    "complex_einsum_engine_form": """
+import jax, jax.numpy as jnp, numpy as np
+rng = np.random.default_rng(0)
+a = (rng.standard_normal((4,8,3)) + 1j*rng.standard_normal((4,8,3))).astype(np.complex64)
+F = (rng.standard_normal((8,8)) + 1j*rng.standard_normal((8,8))).astype(np.complex64)
+got = np.asarray(jax.jit(lambda a,F: jnp.einsum("...jk,jl->...lk", a, F))(a, F))
+err = np.abs(got - np.einsum("...jk,jl->...lk", a, F)).max()
+print_result(ok=bool(err < 1e-3), err=float(err))
+""",
+    "planar_complex_gemm": """
+import jax, jax.numpy as jnp, numpy as np
+rng = np.random.default_rng(0)
+a = (rng.standard_normal((8,8)) + 1j*rng.standard_normal((8,8))).astype(np.complex64)
+b = (rng.standard_normal((8,8)) + 1j*rng.standard_normal((8,8))).astype(np.complex64)
+ar, ai = a.real.copy(), a.imag.copy()
+br, bi = b.real.copy(), b.imag.copy()
+def planar(ar, ai, br, bi):
+    # Karatsuba 3-multiply complex GEMM on real operands
+    t1 = ar @ br
+    t2 = ai @ bi
+    t3 = (ar + ai) @ (br + bi)
+    return t1 - t2, t3 - t1 - t2
+re, im = jax.jit(planar)(ar, ai, br, bi)
+got = np.asarray(re) + 1j*np.asarray(im)
+err = np.abs(got - a @ b).max()
+print_result(ok=bool(err < 1e-3), err=float(err))
+""",
+    "complex_transfer_only": """
+import jax, numpy as np
+z = np.array([1+2j, 3-1j], np.complex64)
+d = jax.device_put(z)
+back = np.asarray(d)
+err = abs(back - z).max()
+print_result(ok=bool(err == 0.0), err=float(err))
+""",
+    "all_to_all_f32_1dev": """
+import jax, jax.numpy as jnp, numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+mesh = Mesh(np.array(jax.devices()[:1]), ("p",))
+x = np.arange(16, dtype=np.float32).reshape(4, 4)
+f = shard_map(lambda a: jax.lax.all_to_all(a, "p", 0, 0, tiled=True),
+              mesh=mesh, in_specs=P("p", None), out_specs=P("p", None))
+got = np.asarray(jax.jit(f)(x))
+print_result(ok=bool(np.array_equal(got, x)))
+""",
+    "complex_boundary_ops": """
+import jax, jax.numpy as jnp, numpy as np
+z = np.array([1+2j, 3-1j], np.complex64)
+f = jax.jit(lambda a: jax.lax.complex(jnp.real(a) * 2, jnp.imag(a)))
+got = np.asarray(f(jnp.asarray(z)))
+err = abs(got - (z.real*2 + 1j*z.imag)).max()
+print_result(ok=bool(err < 1e-5), err=float(err))
+""",
+    "planar_dft_1d": """
+import os
+os.environ["PYLOPS_MPI_TPU_FFT_MODE"] = "planar"
+import numpy as np, jax, jax.numpy as jnp
+from pylops_mpi_tpu.ops import dft
+rng = np.random.default_rng(0)
+x = rng.standard_normal(64).astype(np.float32)
+# pure plane-pair API: no complex dtype anywhere on device
+yr, yi = jax.jit(lambda v: dft.fft_planes(v, None))(jnp.asarray(x))
+got = np.asarray(yr) + 1j*np.asarray(yi)
+want = np.fft.fft(x)
+err = np.linalg.norm(got - want)/np.linalg.norm(want)
+print_result(ok=bool(err < 1e-3), err=float(err))
+""",
+    "matmul_dft_1d": """
+import os
+os.environ["PYLOPS_MPI_TPU_FFT_MODE"] = "matmul"
+import numpy as np, jax.numpy as jnp
+from pylops_mpi_tpu.ops import dft
+rng = np.random.default_rng(0)
+x = (rng.standard_normal(64) + 1j*rng.standard_normal(64)).astype(np.complex64)
+got = np.asarray(dft.fft(jnp.asarray(x), 64, -1))
+want = np.fft.fft(x)
+err = np.linalg.norm(got - want)/np.linalg.norm(want)
+print_result(ok=bool(err < 1e-3), err=float(err))
+""",
+    "pencil_fft2d_small": """
+import os
+os.environ["PYLOPS_MPI_TPU_FFT_MODE"] = "matmul"
+import numpy as np
+import pylops_mpi_tpu as pmt
+dims = (16, 8)
+Op = pmt.MPIFFT2D(dims=dims, dtype=np.complex64)
+rng = np.random.default_rng(0)
+x = (rng.standard_normal(dims) + 1j*rng.standard_normal(dims)).astype(np.complex64)
+y = Op @ pmt.DistributedArray.to_dist(x.ravel())
+got = np.asarray(y.asarray()).reshape(Op.dimsd_nd)
+want = np.fft.fft2(x)
+err = np.linalg.norm(got - want)/np.linalg.norm(want)
+print_result(ok=bool(err < 1e-3), err=float(err))
+""",
+    "pencil_fft2d_planar": """
+import os
+os.environ["PYLOPS_MPI_TPU_FFT_MODE"] = "planar"
+import numpy as np
+import pylops_mpi_tpu as pmt
+dims = (16, 8)
+Op = pmt.MPIFFT2D(dims=dims, dtype=np.complex64)
+rng = np.random.default_rng(0)
+x = (rng.standard_normal(dims) + 1j*rng.standard_normal(dims)).astype(np.complex64)
+y = Op @ pmt.DistributedArray.to_dist(x.ravel())
+got = np.asarray(y.asarray()).reshape(Op.dimsd_nd)
+want = np.fft.fft2(x)
+err = np.linalg.norm(got - want)/np.linalg.norm(want)
+print_result(ok=bool(err < 1e-3), err=float(err))
+""",
+}
+
+_PRELUDE = """
+import json, os, sys
+if os.environ.get("PYLOPS_MPI_TPU_PLATFORM", "") == "cpu":
+    # CPU rehearsal: env JAX_PLATFORMS alone is insufficient (the
+    # sitecustomize TPU plugin overrides it and hangs at backend init
+    # when the tunnel is down — see bench.py child_main)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+def print_result(**kw):
+    try:  # hardware-evidence tag: rehearsal (cpu) must not read as tpu
+        import jax
+        kw.setdefault("platform", jax.default_backend())
+    except Exception:
+        pass
+    print("@@RESULT@@" + json.dumps(kw))
+    sys.stdout.flush()
+try:
+"""
+
+_POSTLUDE = """
+except Exception as e:
+    print("@@RESULT@@" + json.dumps(
+        {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}))
+"""
+
+
+def run_probe(name: str, timeout: int) -> dict:
+    body = "".join("    " + ln + "\n"
+                   for ln in PROBES[name].strip().splitlines())
+    src = _PRELUDE + body + _POSTLUDE
+    t0 = time.perf_counter()
+    try:
+        p = subprocess.run([sys.executable, "-c", src], cwd=_ROOT,
+                           capture_output=True, text=True,
+                           timeout=timeout)
+        out = {"ok": False, "error": "no result line"}
+        for ln in p.stdout.splitlines():
+            if ln.startswith("@@RESULT@@"):
+                try:  # a child killed mid-write leaves a truncated
+                    # line; one bad probe must not lose the others
+                    out = json.loads(ln[len("@@RESULT@@"):])
+                except json.JSONDecodeError:
+                    out = {"ok": False,
+                           "error": f"truncated result: {ln[:120]}"}
+        if not p.stdout.strip() and p.returncode != 0:
+            out = {"ok": False,
+                   "error": f"exit {p.returncode}: {p.stderr[-200:]}"}
+    except subprocess.TimeoutExpired:
+        out = {"ok": False, "error": f"timeout after {timeout}s"}
+    out["s"] = round(time.perf_counter() - t0, 1)
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeout", type=int, default=180)
+    ap.add_argument("--only", help="comma-separated probe names")
+    args = ap.parse_args()
+    names = (args.only.split(",") if args.only else list(PROBES))
+    results = {}
+    for name in names:
+        results[name] = run_probe(name, args.timeout)
+        print(json.dumps({name: results[name]}), flush=True)
+    print(json.dumps({"kind": "tpu_fft_bisect", "ts": time.time(),
+                      "results": results}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -143,6 +143,21 @@ def _stage_diag(env):
                 "diag timeout" if steps else "diag timeout with no steps")
 
 
+def _stage_bisect(env):
+    """Complex-support bisect (benchmarks/tpu_fft_bisect.py): the
+    round-5 selfcheck showed every real kernel green and the pencil
+    FFT dead with runtime UNIMPLEMENTED even on the matmul engine.
+    One fresh child per probe (a failing complex program wedges the
+    client); the parent never initializes the TPU backend itself, so
+    the chip is free for each child in turn. Also validates the
+    planar-engine fix (mode=planar pencil) on hardware."""
+    return _bench_mod()._run_json_cmd(
+        [sys.executable, "-u",
+         os.path.join(_HERE, "tpu_fft_bisect.py"), "--timeout", "150"],
+        env, timeout=int(os.environ.get("PROBE_BISECT_TIMEOUT", "1200")),
+        cwd=_ROOT)
+
+
 def _stage_breakdown(env):
     """Latency attribution for the flagship (benchmarks/tpu_breakdown.py):
     fixed-vs-marginal niter fit, standalone sweep time, reduction
@@ -224,6 +239,7 @@ def harvest(cache: dict, rehearse: bool = False) -> dict:
         # before the longer diagnosis/size ladder gets a chance to eat it
         ("selfcheck", lambda: _stage_selfcheck(env)),
         ("flagship_small", lambda: _stage_flagship(env, "small")),
+        ("bisect", lambda: _stage_bisect(env)),
         ("breakdown", lambda: _stage_breakdown(env)),
         ("diag", lambda: _stage_diag(env)),
         ("flagship_mid", lambda: _stage_flagship(env, "mid")),

@@ -218,7 +218,7 @@ def run_selfcheck() -> dict:
                         want)
     checks["pencil_fft2d"] = dict(
         _check(fft, tol=1e-3),
-        engine="matmul" if _dft.use_matmul_fft() else "xla")
+        engine=_dft.resolved_mode())
 
     # --- does this runtime implement the XLA fft custom-call at all?
     # LAST: a runtime UNIMPLEMENTED here wedges the process (see the

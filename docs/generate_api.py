@@ -75,7 +75,9 @@ PAGES = {
           "batched_normal_matvec", "normal_matvec_supported",
           "pallas_available"]),
         ("Local FFT engine", "pylops_mpi_tpu.ops.dft",
-         ["fft", "ifft", "rfft", "irfft", "fft_mode", "set_fft_mode", "use_matmul_fft"]),
+         ["fft", "ifft", "rfft", "irfft", "fft_mode", "set_fft_mode",
+          "use_matmul_fft", "resolved_mode", "fft_planes", "ifft_planes",
+          "rfft_planes", "irfft_planes"]),
     ],
     "utils": [
         ("Testing", "pylops_mpi_tpu.utils.dottest", ["dottest"]),

@@ -48,6 +48,19 @@ Mode selection (``PYLOPS_MPI_TPU_FFT_MODE``):
   relative at n=4096 under ``highest`` matmul precision).
 - ``xla``: always ``jnp.fft``.
 - ``matmul``: force the GEMM engine (also useful on CPU for tests).
+- ``planar``: the GEMM engine on two REAL planes (re, im) — no complex
+  dtype ever reaches the device. Each stage GEMM runs as 3 real GEMMs
+  (Karatsuba: ``t1 = ar·Fr``, ``t2 = ai·Fi``,
+  ``t3 = (ar+ai)·(Fr+Fi)``, with the constant ``Fr+Fi`` folded on the
+  host) — 0.75× the 4-real-GEMM lowering native complex matmuls get.
+  Built for runtimes whose TPU backend lacks complex lowering
+  entirely: the round-5 hardware selfcheck measured every real-valued
+  kernel green while every complex-dtype program (including the
+  matmul engine) died with runtime ``UNIMPLEMENTED``. The
+  ``*_planes`` functions expose the plane-pair API directly so
+  distributed kernels can stay complex-free end-to-end (collectives
+  included); the ``jnp.fft``-signature wrappers convert at the
+  boundary (``real``/``imag`` in, ``lax.complex`` out).
 
 The mode is read ONCE at first use and cached for determinism —
 flipping the env var after any transform has run is ignored (jit
@@ -67,9 +80,10 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["fft", "ifft", "rfft", "irfft", "fft_mode", "set_fft_mode",
-           "use_matmul_fft"]
+           "use_matmul_fft", "resolved_mode", "fft_planes",
+           "ifft_planes", "rfft_planes", "irfft_planes"]
 
-_mode_cache: str | None = None  # resolved mode ("xla"/"matmul")
+_mode_cache: str | None = None  # resolved mode ("xla"/"matmul"/"planar")
 _base_cache: int | None = None  # resolved direct-GEMM base length
 
 
@@ -129,42 +143,60 @@ def _fftless_runtime() -> bool:
 
 def fft_mode() -> str:
     m = os.environ.get("PYLOPS_MPI_TPU_FFT_MODE", "auto").lower()
-    if m not in ("auto", "xla", "matmul"):
+    if m not in ("auto", "xla", "matmul", "planar"):
         raise ValueError(f"PYLOPS_MPI_TPU_FFT_MODE={m!r}: expected "
-                         "auto|xla|matmul")
+                         "auto|xla|matmul|planar")
     return m
 
 
 def set_fft_mode(mode: str | None) -> None:
-    """Pin the local-FFT engine (``"xla"``/``"matmul"``), or ``None``
-    to re-resolve from the environment on next use. Clears JAX's jit
-    caches so operators traced under the previous mode retrace."""
+    """Pin the local-FFT engine (``"xla"``/``"matmul"``/``"planar"``),
+    or ``None`` to re-resolve from the environment on next use. Clears
+    JAX's jit caches so operators traced under the previous mode
+    retrace."""
     global _mode_cache, _base_cache
-    if mode is not None and mode not in ("xla", "matmul"):
+    if mode is not None and mode not in ("xla", "matmul", "planar"):
         raise ValueError(f"set_fft_mode({mode!r}): expected "
-                         "'xla', 'matmul' or None")
+                         "'xla', 'matmul', 'planar' or None")
     _mode_cache = mode
     _base_cache = None  # re-resolve the GEMM base with the mode
     jax.clear_caches()
 
 
-def use_matmul_fft() -> bool:
+def resolved_mode() -> str:
+    """The engine actually in use ("xla"/"matmul"/"planar"), resolving
+    and caching ``auto`` on first call."""
     global _mode_cache
     if _mode_cache is None:
         m = fft_mode()
         if m == "auto":
             if jax.default_backend() == "tpu" and _fftless_runtime():
-                m = "matmul"
+                # planar, not matmul: the round-5 hardware selfcheck
+                # showed the known FFT-less runtime also lacks complex
+                # lowering altogether (every complex program, the
+                # matmul engine included, hit runtime UNIMPLEMENTED
+                # while all real kernels passed)
+                m = "planar"
                 warnings.warn(
                     "pylops_mpi_tpu: this TPU runtime is known to lack "
-                    "the XLA fft custom-call; using the matmul DFT "
-                    "engine (~1e-5 f32 accuracy). On a real TPU pod set "
+                    "the XLA fft custom-call (and complex lowering); "
+                    "using the planar-GEMM DFT engine (~1e-5 f32 "
+                    "accuracy). On a real TPU pod set "
                     "PYLOPS_MPI_TPU_FFT_MODE=xla for the native FFT.",
                     stacklevel=2)
             else:
                 m = "xla"
         _mode_cache = m
-    return _mode_cache == "matmul"
+    return _mode_cache
+
+
+def use_matmul_fft() -> bool:
+    """True when a GEMM engine (matmul or planar) replaces ``jnp.fft``
+    for local transforms (the name predates the planar mode; kept for
+    API stability — callers use it to pick oracle tolerances and
+    radix-aware flop counts, which are identical for the two GEMM
+    engines)."""
+    return resolved_mode() in ("matmul", "planar")
 
 
 # --------------------------------------------------------------- helpers
@@ -323,23 +355,289 @@ def _matmul_fft_1d(x: jax.Array, n, axis: int, sign: float,
     return jnp.moveaxis(y, -1, axis)
 
 
+# --------------------------------------------------------- planar engine
+# Complex arithmetic on (re, im) pairs of REAL arrays — the same
+# mixed-radix recursion as the complex engine above, with every
+# complex constant pre-split on the host and every stage GEMM run as
+# 3 real GEMMs (Karatsuba). No complex dtype ever reaches the device:
+# built for runtimes without complex lowering (see module docstring)
+# and usable as a pure-real engine by distributed kernels that want
+# complex-free collectives (``fft_planes``/``rfft_planes``...).
+
+
+def _plane_dtype(dtype) -> str:
+    return "float64" if np.dtype(dtype) in (np.complex128, np.float64) \
+        else "float32"
+
+
+@lru_cache(maxsize=128)
+def _dft_mat_planar_np(n: int, sign: float, dtype: str):
+    F = _dft_mat_np(n, sign, "complex128")
+    Fr = np.ascontiguousarray(F.real, dtype)
+    Fi = np.ascontiguousarray(F.imag, dtype)
+    return Fr, Fi, (Fr + Fi).astype(dtype)
+
+
+@lru_cache(maxsize=128)
+def _twiddle_planar_np(n1: int, n2: int, sign: float, dtype: str):
+    T = _twiddle_np(n1, n2, sign, "complex128")
+    return (np.ascontiguousarray(T.real, dtype),
+            np.ascontiguousarray(T.imag, dtype))
+
+
+@lru_cache(maxsize=128)
+def _half_twiddle_planar_np(m: int, sign: float, dtype: str):
+    W = _half_twiddle_np(m, sign, "complex128")
+    return (np.ascontiguousarray(W.real, dtype),
+            np.ascontiguousarray(W.imag, dtype))
+
+
+@lru_cache(maxsize=64)
+def _bluestein_consts_planar(n: int, sign: float, dtype: str):
+    m, chirp, hf = _bluestein_consts(n, sign, "complex128")
+    return (m,
+            np.ascontiguousarray(chirp.real, dtype),
+            np.ascontiguousarray(chirp.imag, dtype),
+            np.ascontiguousarray(hf.real, dtype),
+            np.ascontiguousarray(hf.imag, dtype))
+
+
+def _kgemm_last(ar, ai, consts):
+    """(ar + i·ai) @ (Fr + i·Fi) as 3 real GEMMs (Karatsuba); the
+    third operand ``Fr + Fi`` is a host constant, so the only extra
+    elementwise work over 4-GEMM is one add on the data and two on the
+    outputs."""
+    Fr, Fi, Frpi = (jnp.asarray(c) for c in consts)
+    t1 = ar @ Fr
+    t2 = ai @ Fi
+    t3 = (ar + ai) @ Frpi
+    return t1 - t2, t3 - t1 - t2
+
+
+def _kein(ar, ai, consts):
+    """Karatsuba complex contraction over axis -2 (the split stage's
+    ``...jk,jl->...lk`` einsum) on plane pairs."""
+    Fr, Fi, Frpi = (jnp.asarray(c) for c in consts)
+
+    def e(a, F):
+        return jnp.einsum("...jk,jl->...lk", a, F)
+
+    t1, t2, t3 = e(ar, Fr), e(ai, Fi), e(ar + ai, Frpi)
+    return t1 - t2, t3 - t1 - t2
+
+
+def _cmul_planar(ar, ai, wr, wi):
+    """Elementwise complex multiply on planes (plain 4-multiply: these
+    are bandwidth-bound, Karatsuba saves nothing here)."""
+    return ar * wr - ai * wi, ar * wi + ai * wr
+
+
+def _fft_last_p(ar, ai, sign: float):
+    """Unscaled planar DFT along the last axis; mirrors
+    :func:`_fft_last` stage for stage."""
+    n = ar.shape[-1]
+    dt = str(np.dtype(ar.dtype))
+    if n <= _gemm_base():
+        return _kgemm_last(ar, ai, _dft_mat_planar_np(n, sign, dt))
+    n1 = _best_split(n)
+    if n1 == 1:
+        return _bluestein_last_p(ar, ai, sign)
+    n2 = n // n1
+    shp = ar.shape[:-1] + (n1, n2)
+    br, bi = _kein(ar.reshape(shp), ai.reshape(shp),
+                   _dft_mat_planar_np(n1, sign, dt))
+    wr, wi = _twiddle_planar_np(n1, n2, sign, dt)
+    br, bi = _cmul_planar(br, bi, jnp.asarray(wr), jnp.asarray(wi))
+    cr, ci = _fft_last_p(br, bi, sign)
+
+    def interleave(c):
+        return jnp.swapaxes(c, -1, -2).reshape(shp[:-2] + (n,))
+
+    return interleave(cr), interleave(ci)
+
+
+def _bluestein_last_p(ar, ai, sign: float):
+    n = ar.shape[-1]
+    dt = str(np.dtype(ar.dtype))
+    m, cr_np, ci_np, hr_np, hi_np = _bluestein_consts_planar(n, sign, dt)
+    cr, ci = jnp.asarray(cr_np), jnp.asarray(ci_np)
+    xr, xi = _cmul_planar(ar, ai, cr, ci)
+    z = jnp.zeros(ar.shape[:-1] + (m - n,), ar.dtype)
+    Xr, Xi = _fft_last_p(jnp.concatenate([xr, z], axis=-1),
+                         jnp.concatenate([xi, z], axis=-1), -1.0)
+    Xr, Xi = _cmul_planar(Xr, Xi, jnp.asarray(hr_np), jnp.asarray(hi_np))
+    yr, yi = _fft_last_p(Xr, Xi, +1.0)
+    return _cmul_planar(yr[..., :n] / m, yi[..., :n] / m, cr, ci)
+
+
+def _pad_trunc_plane(x, n: int, axis: int):
+    """jnp.fft pad/truncate semantics on one real plane."""
+    src_n = x.shape[axis]
+    if n == src_n:
+        return x
+    if n < src_n:
+        return jax.lax.slice_in_dim(x, 0, n, axis=axis)
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, n - src_n)
+    return jnp.pad(x, pad)
+
+
+def fft_planes(xr, xi, n=None, axis: int = -1, norm=None, *,
+               sign: float = -1.0):
+    """Forward DFT on a (re, im) plane pair; returns ``(yr, yi)``.
+    ``jnp.fft.fft`` semantics (pad/truncate to ``n``, same ``norm``
+    conventions) without any complex dtype on device."""
+    xr = jnp.asarray(xr)
+    xi = jnp.zeros_like(xr) if xi is None else jnp.asarray(xi)
+    pdt = _plane_dtype(jnp.result_type(xr.dtype, xi.dtype))
+    xr, xi = xr.astype(pdt), xi.astype(pdt)
+    if n is not None:
+        xr = _pad_trunc_plane(xr, n, axis)
+        xi = _pad_trunc_plane(xi, n, axis)
+    xr = jnp.moveaxis(xr, axis, -1)
+    xi = jnp.moveaxis(xi, axis, -1)
+    yr, yi = _fft_last_p(xr, xi, sign)
+    nn = yr.shape[-1]
+    yr = _norm_scale(yr, nn, sign, norm)
+    yi = _norm_scale(yi, nn, sign, norm)
+    return jnp.moveaxis(yr, -1, axis), jnp.moveaxis(yi, -1, axis)
+
+
+def ifft_planes(xr, xi, n=None, axis: int = -1, norm=None):
+    return fft_planes(xr, xi, n=n, axis=axis, norm=norm, sign=+1.0)
+
+
+def _planar_complex_1d(x, n, axis: int, sign: float, norm):
+    """Complex-in/complex-out wrapper over the planar core: only the
+    boundary ``real``/``imag``/``lax.complex`` ops touch a complex
+    dtype (pure representation ops — no complex arithmetic kernels)."""
+    pdt = _plane_dtype(_complex_dtype(x))
+    xr = jnp.real(x).astype(pdt)
+    xi = (jnp.imag(x).astype(pdt) if jnp.iscomplexobj(x)
+          else jnp.zeros_like(xr))
+    yr, yi = fft_planes(xr, xi, n=n, axis=axis, norm=norm, sign=sign)
+    return jax.lax.complex(yr, yi)
+
+
+def rfft_planes(x, n=None, axis: int = -1, norm=None):
+    """Real-input forward DFT returning the half-spectrum as a plane
+    pair. Even lengths use the packed-real trick natively: the two
+    planes of the half-length transform input ARE the even/odd
+    deinterleave, so packing costs nothing."""
+    x = jnp.asarray(x)
+    if jnp.iscomplexobj(x):  # numpy allows it; run the full transform
+        # on the planes directly — no complex-dtype device ops even on
+        # this fallback (the boundary real/imag pair is all it needs)
+        pdt = _plane_dtype(x.dtype)
+        nn = x.shape[axis] if n is None else n
+        yr, yi = fft_planes(jnp.real(x).astype(pdt),
+                            jnp.imag(x).astype(pdt),
+                            n=nn, axis=axis, norm=norm)
+        keep = nn // 2 + 1
+        return (jax.lax.slice_in_dim(yr, 0, keep, axis=axis),
+                jax.lax.slice_in_dim(yi, 0, keep, axis=axis))
+    nn = x.shape[axis] if n is None else n
+    pdt = _plane_dtype(x.dtype)
+    x = x.astype(pdt)
+    if nn % 2 or nn < 4:
+        yr, yi = fft_planes(x, None, n=nn, axis=axis, norm=norm)
+        keep = nn // 2 + 1
+        return (jax.lax.slice_in_dim(yr, 0, keep, axis=axis),
+                jax.lax.slice_in_dim(yi, 0, keep, axis=axis))
+    x = _pad_trunc_plane(x, nn, axis)
+    x = jnp.moveaxis(x, axis, -1)
+    m = nn // 2
+    xp = x.reshape(x.shape[:-1] + (m, 2))
+    Zr, Zi = _fft_last_p(xp[..., 0], xp[..., 1], -1.0)  # (…, m) unscaled
+    Zr = jnp.concatenate([Zr, Zr[..., :1]], axis=-1)    # Z[m] := Z[0]
+    Zi = jnp.concatenate([Zi, Zi[..., :1]], axis=-1)
+    Rr, Ri = jnp.flip(Zr, axis=-1), -jnp.flip(Zi, axis=-1)  # conj Z[m-k]
+    Er, Ei = 0.5 * (Zr + Rr), 0.5 * (Zi + Ri)           # DFT of x_even
+    # O = -i/2 · (Z - R):  Or = (Zi-Ri)/2,  Oi = -(Zr-Rr)/2
+    Or, Oi = 0.5 * (Zi - Ri), -0.5 * (Zr - Rr)          # DFT of x_odd
+    wr, wi = _half_twiddle_planar_np(m, -1.0, pdt)
+    WOr, WOi = _cmul_planar(Or, Oi, jnp.asarray(wr), jnp.asarray(wi))
+    yr = _norm_scale(Er + WOr, nn, -1.0, norm)
+    yi = _norm_scale(Ei + WOi, nn, -1.0, norm)
+    return jnp.moveaxis(yr, -1, axis), jnp.moveaxis(yi, -1, axis)
+
+
+def irfft_planes(xr, xi, n=None, axis: int = -1, norm=None):
+    """Inverse of :func:`rfft_planes`: half-spectrum planes in, REAL
+    array out (``jnp.fft.irfft`` semantics)."""
+    xr, xi = jnp.asarray(xr), jnp.asarray(xi)
+    pdt = _plane_dtype(jnp.result_type(xr.dtype, xi.dtype))
+    xr, xi = xr.astype(pdt), xi.astype(pdt)
+    nh = xr.shape[axis]
+    nn = 2 * (nh - 1) if n is None else n
+    keep = nn // 2 + 1
+    xr = _pad_trunc_plane(xr, keep, axis)
+    xi = _pad_trunc_plane(xi, keep, axis)
+    if nn % 2 or nn < 4:
+        # rebuild the full Hermitian spectrum and run the full engine
+        hi = keep - 1 if nn % 2 == 0 else keep
+        mr = jax.lax.slice_in_dim(xr, 1, hi, axis=axis)
+        mi = jax.lax.slice_in_dim(xi, 1, hi, axis=axis)
+        fr = jnp.concatenate([xr, jnp.flip(mr, axis=axis)], axis=axis)
+        fi = jnp.concatenate([xi, -jnp.flip(mi, axis=axis)], axis=axis)
+        yr, _ = fft_planes(fr, fi, n=nn, axis=axis, norm=norm, sign=+1.0)
+        return yr
+    Xr = jnp.moveaxis(xr, axis, -1)
+    Xi = jnp.moveaxis(xi, axis, -1)
+    m = nn // 2
+    # DC and Nyquist bins are real by assumption (numpy semantics):
+    # zero their imaginary parts so they can't leak into the untangle
+    Xi = jnp.concatenate([jnp.zeros_like(Xi[..., :1]), Xi[..., 1:m],
+                          jnp.zeros_like(Xi[..., m:])], axis=-1)
+    Rr, Ri = jnp.flip(Xr, axis=-1), -jnp.flip(Xi, axis=-1)  # conj X[m-k]
+    Er, Ei = 0.5 * (Xr + Rr), 0.5 * (Xi + Ri)
+    wr, wi = _half_twiddle_planar_np(m, -1.0, pdt)
+    # O = (X - R)/2 · conj(W)
+    Or, Oi = _cmul_planar(0.5 * (Xr - Rr), 0.5 * (Xi - Ri),
+                          jnp.asarray(wr), -jnp.asarray(wi))
+    # Z = E + i·O  →  Zr = Er - Oi, Zi = Ei + Or;  keep k = 0..m-1
+    ur, ui = _fft_last_p((Er - Oi)[..., :m], (Ei + Or)[..., :m], +1.0)
+    y = jnp.stack([ur, ui], axis=-1).reshape(ur.shape[:-1] + (nn,))
+    # u carries an extra factor m over the backward-normalised signal
+    if norm in (None, "backward"):
+        y = y / m
+    elif norm == "ortho":
+        y = y * (2.0 / np.sqrt(nn))
+    elif norm == "forward":
+        y = y * 2.0
+    else:
+        raise ValueError(f"unsupported norm {norm!r}: expected None, "
+                         "'backward', 'ortho' or 'forward'")
+    return jnp.moveaxis(y, -1, axis)
+
+
 # ------------------------------------------------------------- public API
 
 def fft(x, n=None, axis: int = -1, norm=None):
-    if not use_matmul_fft():
+    mode = resolved_mode()
+    if mode == "xla":
         return jnp.fft.fft(x, n=n, axis=axis, norm=norm)
+    if mode == "planar":
+        return _planar_complex_1d(x, n, axis, -1.0, norm)
     return _matmul_fft_1d(x, n, axis, -1.0, norm)
 
 
 def ifft(x, n=None, axis: int = -1, norm=None):
-    if not use_matmul_fft():
+    mode = resolved_mode()
+    if mode == "xla":
         return jnp.fft.ifft(x, n=n, axis=axis, norm=norm)
+    if mode == "planar":
+        return _planar_complex_1d(x, n, axis, +1.0, norm)
     return _matmul_fft_1d(x, n, axis, +1.0, norm)
 
 
 def rfft(x, n=None, axis: int = -1, norm=None):
-    if not use_matmul_fft():
+    mode = resolved_mode()
+    if mode == "xla":
         return jnp.fft.rfft(x, n=n, axis=axis, norm=norm)
+    if mode == "planar":
+        yr, yi = rfft_planes(x, n=n, axis=axis, norm=norm)
+        return jax.lax.complex(yr, yi)
     nn = x.shape[axis] if n is None else n
     if nn % 2 or nn < 4 or jnp.iscomplexobj(x):
         # odd length (no even/odd split) or complex input (numpy
@@ -374,8 +672,15 @@ def rfft(x, n=None, axis: int = -1, norm=None):
 
 
 def irfft(x, n=None, axis: int = -1, norm=None):
-    if not use_matmul_fft():
+    mode = resolved_mode()
+    if mode == "xla":
         return jnp.fft.irfft(x, n=n, axis=axis, norm=norm)
+    if mode == "planar":
+        pdt = _plane_dtype(x.dtype)
+        xr = jnp.real(x).astype(pdt)
+        xi = (jnp.imag(x).astype(pdt) if jnp.iscomplexobj(x)
+              else jnp.zeros_like(xr))
+        return irfft_planes(xr, xi, n=n, axis=axis, norm=norm)
     nh = x.shape[axis]
     nn = 2 * (nh - 1) if n is None else n
     keep = nn // 2 + 1
