@@ -888,17 +888,28 @@ def _merge_tpu_cache(result, root=None):
     if r and r.get("platform") == "tpu" and "tpu_breakdown" not in result:
         result["tpu_breakdown"] = {**r, "cached": True,
                                    "ts": ent.get("ts")}
-    ent = cache.get("bisect") or {}
-    r = ent.get("result")
-    if r and isinstance(r.get("results"), dict):
+    for stage, out_key in (("bisect", "tpu_bisect"),
+                           ("fft_planar", "tpu_fft_planar")):
+        ent = cache.get(stage) or {}
+        r = ent.get("result")
+        if not (r and isinstance(r.get("results"), dict)):
+            continue
         probes = r["results"]
         plats = {v.get("platform") for v in probes.values()
                  if isinstance(v, dict)} - {None}
         # same hardware-evidence rule as the selfcheck/diag merges: a
-        # rehearsal bisect (cpu children) proves nothing about the chip
-        if plats == {"tpu"}:
-            result["tpu_bisect"] = {
+        # rehearsal bisect (cpu children) proves nothing about the
+        # chip. An EMPTY platform set is NOT the same thing: a probe
+        # only tags its platform on success, so a hardware window in
+        # which every probe died (round 5: the whole complex-FFT
+        # family UNIMPLEMENTED) emits no tags at all — that all-fail
+        # outcome IS the round's evidence. Accept it whenever the
+        # harvest wasn't a rehearsal (the daemon stamps those).
+        if plats == {"tpu"} or (not plats and not ent.get("rehearse")):
+            result[out_key] = {
                 "ts": ent.get("ts"), "code_rev": ent.get("code_rev"),
+                **({"platform": "tpu"} if plats == {"tpu"}
+                   else {"all_probes_failed": True}),
                 "probes": {k: {"ok": v.get("ok"),
                                **({"error": v.get("error")}
                                   if v.get("error") else {})}
@@ -999,13 +1010,23 @@ def _compact_line(result):
             "vs_sweep": bd.get("while_loop_marginal_vs_sweep"),
             "reduction_ms": bd.get("reduction_overhead_per_iter_ms"),
             "dispatch_ms": bd.get("dispatch_ms")}
+    fp = result.get("tpu_fft_planar") or {}
+    if fp:
+        pr = fp.get("probes") or {}
+        compact["fft_planar"] = {
+            "ok": sum(1 for v in pr.values()
+                      if isinstance(v, dict) and v.get("ok")),
+            "total": len(pr) or None,
+            **({"all_failed": True} if fp.get("all_probes_failed")
+               else {})}
     if probe:
         compact["probe"] = {"attempts": probe.get("attempts"),
                             "statuses": probe.get("statuses"),
                             "last_ts": probe.get("last_ts")}
     # hard ≤2KB guarantee: shed optional detail, most-expendable first
     for victim in ("probe", "components", "bf16_race", "bf16", "f32",
-                   "flagship_1dev_cpu", "tpu_breakdown", "selfcheck"):
+                   "flagship_1dev_cpu", "tpu_breakdown", "fft_planar",
+                   "selfcheck"):
         if len(json.dumps(compact)) <= 2000:
             break
         compact.pop(victim, None)

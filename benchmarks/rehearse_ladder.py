@@ -2,7 +2,9 @@
 
 Proves, without hardware, that a live TPU window will be spent
 correctly: the exact probe-daemon stage sequence
-(selfcheck → small → breakdown → diag → mid → full) runs on a CPU
+(selfcheck → small → fft_planar → full → mid → bisect → breakdown →
+diag; the round-6 reorder banks the planar-FFT verdict and the
+N=4096 headline BEFORE the 900 s diagnosis stages) runs on a CPU
 8-virtual-device mesh in TPU ordering (headline banked before
 components), every stage banks a result within its configured budget,
 the persistent XLA compile cache hits across the bench child
@@ -33,8 +35,9 @@ sys.path.insert(0, _ROOT)
 sys.path.insert(0, _HERE)  # for tpu_probe_loop.rehearse_env
 
 BUDGETS = {  # seconds; the real window budgets this rehearsal enforces
-    "selfcheck": 600, "flagship_small": 600, "breakdown": 700,
-    "diag": 700, "flagship_mid": 1200, "flagship_full": 2400,
+    "selfcheck": 600, "flagship_small": 600, "fft_planar": 600,
+    "breakdown": 700, "diag": 700, "flagship_mid": 1200,
+    "flagship_full": 2400,
 }
 
 

@@ -60,7 +60,7 @@ def main():
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from pylops_mpi_tpu.jaxcompat import shard_map
 
     import pylops_mpi_tpu as pmt
     from pylops_mpi_tpu.ops import pallas_kernels as pk

@@ -165,7 +165,57 @@ want = np.fft.fft2(x)
 err = np.linalg.norm(got - want)/np.linalg.norm(want)
 print_result(ok=bool(err < 1e-3), err=float(err))
 """,
+    "pencil_fft2d_planes_api": """
+# plane-aware pencil: REAL planes in and out, forward AND adjoint —
+# zero complex dtypes anywhere, boundary included (the maximal
+# hardware validation of the planar distributed mode; a complex
+# transfer/representation gap in the runtime cannot fail this one)
+import numpy as np
+import pylops_mpi_tpu as pmt
+dims = (16, 8)
+Op = pmt.MPIFFT2D(dims=dims, dtype=np.complex64)
+rng = np.random.default_rng(0)
+x = (rng.standard_normal(dims) + 1j*rng.standard_normal(dims)).astype(np.complex64)
+xr = pmt.DistributedArray.to_dist(x.real.ravel().astype(np.float32))
+xi = pmt.DistributedArray.to_dist(x.imag.ravel().astype(np.float32))
+yr, yi = Op.matvec_planes(xr, xi)
+got = np.asarray(yr.asarray()) + 1j*np.asarray(yi.asarray())
+want = np.fft.fft2(x).ravel()
+err = np.linalg.norm(got - want)/np.linalg.norm(want)
+vr = pmt.DistributedArray.to_dist(np.asarray(yr.asarray()))
+vi = pmt.DistributedArray.to_dist(np.asarray(yi.asarray()))
+zr, zi = Op.rmatvec_planes(vr, vi)
+back = (np.asarray(zr.asarray()) + 1j*np.asarray(zi.asarray())) / x.size
+aerr = np.linalg.norm(back - x.ravel())/np.linalg.norm(x)
+print_result(ok=bool(err < 1e-3 and aerr < 1e-3), err=float(err),
+             adj_err=float(aerr))
+""",
+    "pencil_rfft2d_planar": """
+# real-input planar pencil (the MDC transform family): half-spectrum
+# planes out of matvec_planes, ~half the all-to-all bytes of the
+# complex engine's full-spectrum schedule
+import numpy as np
+import pylops_mpi_tpu as pmt
+dims = (16, 8)
+Op = pmt.MPIFFTND(dims, axes=(0, 1), real=True, dtype=np.float32)
+rng = np.random.default_rng(0)
+x = rng.standard_normal(dims).astype(np.float32)
+xr = pmt.DistributedArray.to_dist(x.ravel())
+yr, yi = Op.matvec_planes(xr)
+got = (np.asarray(yr.asarray()) + 1j*np.asarray(yi.asarray())).reshape(Op.dimsd_nd)
+want = np.fft.rfftn(x, axes=(0, 1))
+want[:, 1:1 + (dims[1]-1)//2] *= np.sqrt(2)
+err = np.linalg.norm(got - want)/np.linalg.norm(want)
+print_result(ok=bool(err < 1e-3), err=float(err))
+""",
 }
+
+# the cheap subset the harvest ladder's fft_planar stage runs FIRST on
+# any live window (seconds each): the 1-D planar engine, the planar
+# pencil through the complex-facing API, the plane-aware pencil
+# (fwd+adj, zero complex dtypes), and the real-input half-spectrum path
+PLANAR_PROBES = ["planar_dft_1d", "pencil_fft2d_planar",
+                 "pencil_fft2d_planes_api", "pencil_rfft2d_planar"]
 
 _PRELUDE = """
 import json, os, sys
@@ -228,8 +278,13 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--timeout", type=int, default=180)
     ap.add_argument("--only", help="comma-separated probe names")
+    ap.add_argument("--planar", action="store_true",
+                    help="run only the cheap planar-mode validation "
+                         "subset (PLANAR_PROBES) — the harvest "
+                         "ladder's fft_planar stage")
     args = ap.parse_args()
-    names = (args.only.split(",") if args.only else list(PROBES))
+    names = (PLANAR_PROBES if args.planar
+             else args.only.split(",") if args.only else list(PROBES))
     results = {}
     for name in names:
         results[name] = run_probe(name, args.timeout)

@@ -158,6 +158,22 @@ def _stage_bisect(env):
         cwd=_ROOT)
 
 
+def _stage_fft_planar(env):
+    """Cheap planar-FFT hardware probe (tpu_fft_bisect.py --planar,
+    seconds per child): validates the complex-free distributed FFT
+    mode — planar 1-D engine, planar pencil, plane-aware fwd+adj API,
+    real-input half-spectrum path — the round-6 number the SURVEY's
+    FFT-family operators are blocked on. Runs EARLY in the ladder so a
+    short window banks it before the expensive diagnosis stages."""
+    return _bench_mod()._run_json_cmd(
+        [sys.executable, "-u",
+         os.path.join(_HERE, "tpu_fft_bisect.py"), "--planar",
+         "--timeout", "150"],
+        env,
+        timeout=int(os.environ.get("PROBE_FFT_PLANAR_TIMEOUT", "700")),
+        cwd=_ROOT)
+
+
 def _stage_breakdown(env):
     """Latency attribution for the flagship (benchmarks/tpu_breakdown.py):
     fixed-vs-marginal niter fit, standalone sweep time, reduction
@@ -235,15 +251,19 @@ def harvest(cache: dict, rehearse: bool = False) -> dict:
     rev = _code_rev()
     stages = [
         # order: cheapest headline evidence first — a short window must
-        # bank a kernel-validity verdict and a small flagship number
-        # before the longer diagnosis/size ladder gets a chance to eat it
+        # bank a kernel-validity verdict, a small flagship number, the
+        # planar-FFT verdict and the FULL flagship (the two numbers
+        # missing for five rounds) BEFORE the 900 s+ diagnosis stages
+        # (breakdown/diag) get a chance to eat the window. flagship_mid
+        # stays as the consolation headline if full dies mid-stage.
         ("selfcheck", lambda: _stage_selfcheck(env)),
         ("flagship_small", lambda: _stage_flagship(env, "small")),
+        ("fft_planar", lambda: _stage_fft_planar(env)),
+        ("flagship_full", lambda: _stage_flagship(env, "full")),
+        ("flagship_mid", lambda: _stage_flagship(env, "mid")),
         ("bisect", lambda: _stage_bisect(env)),
         ("breakdown", lambda: _stage_breakdown(env)),
         ("diag", lambda: _stage_diag(env)),
-        ("flagship_mid", lambda: _stage_flagship(env, "mid")),
-        ("flagship_full", lambda: _stage_flagship(env, "full")),
     ]
     for name, runner in stages:
         prev = cache.get(name)
@@ -268,6 +288,11 @@ def harvest(cache: dict, rehearse: bool = False) -> dict:
         result, err = runner()
         entry = {"ts": _now(), "seconds": round(time.time() - t0, 1),
                  "result": result, "code_rev": rev}
+        if rehearse:
+            # explicit provenance: bench.py's cache merge must never
+            # mistake an all-probes-failed rehearsal (no per-probe
+            # platform tags at all) for hardware evidence
+            entry["rehearse"] = True
         if err:
             entry["error"] = err
         cache[name] = entry

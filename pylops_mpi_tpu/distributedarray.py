@@ -693,7 +693,7 @@ class DistributedArray:
         valid_tab = jnp.asarray(sizes, dtype=jnp.int32)
         out_valid_tab = jnp.asarray(out_sizes, dtype=jnp.int32)
         from .parallel.collectives import halo_slab
-        from jax import shard_map
+        from .jaxcompat import shard_map
         from jax.sharding import PartitionSpec as PSpec
 
         def _iota(shape):

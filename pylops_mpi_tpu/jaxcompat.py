@@ -1,0 +1,32 @@
+"""Version-tolerant imports for jax APIs that moved between releases.
+
+The package targets current jax — where ``shard_map`` is a top-level
+export and its replication check is spelled ``check_vma`` — but must
+also import and run on the 0.4.x line, where it lives in
+``jax.experimental.shard_map`` and the kwarg is ``check_rep`` (CI and
+driver containers pin a different jax generation than the TPU bench
+host). Only APIs the package actually consumes belong here; everything
+else imports ``jax`` directly.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # current jax: top-level export
+    from jax import shard_map as _shard_map
+except ImportError:  # the 0.4.x line
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_SM_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, /, **kwargs):
+    """``jax.shard_map`` with the ``check_vma`` spelling accepted on
+    every supported jax generation (pre-rename releases call the same
+    switch ``check_rep``)."""
+    if "check_vma" in kwargs and "check_vma" not in _SM_PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)

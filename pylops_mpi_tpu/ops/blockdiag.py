@@ -193,7 +193,7 @@ class MPIBlockDiag(MPILinearOperator):
         if not self.has_fused_normal:
             return super().normal_matvec(x)
         from jax.sharding import PartitionSpec as P
-        from jax import shard_map
+        from ..jaxcompat import shard_map
         from .pallas_kernels import normal_matvec_supported
         if self._ffi_normal_usable() \
                 and np.dtype(x.dtype) == np.dtype(self._batched.dtype):

@@ -57,10 +57,16 @@ Mode selection (``PYLOPS_MPI_TPU_FFT_MODE``):
   entirely: the round-5 hardware selfcheck measured every real-valued
   kernel green while every complex-dtype program (including the
   matmul engine) died with runtime ``UNIMPLEMENTED``. The
-  ``*_planes`` functions expose the plane-pair API directly so
-  distributed kernels can stay complex-free end-to-end (collectives
-  included); the ``jnp.fft``-signature wrappers convert at the
-  boundary (``real``/``imag`` in, ``lax.complex`` out).
+  ``*_planes`` functions expose the plane-pair API directly and ARE
+  consumed end-to-end by the distributed stack: the pencil FFT
+  kernels (``ops/fft.py``) carry (re, im) plane pairs through their
+  shard_map all-to-all transposes and the planar MDC chain
+  (``ops/mdc.py``) keeps its frequency vectors as stacked real
+  planes, so under this mode no complex dtype appears anywhere in
+  the compiled distributed programs (pinned by
+  ``tests/test_fft.py::test_planar_pencil_hlo_complex_free``). The
+  ``jnp.fft``-signature wrappers convert at the boundary
+  (``real``/``imag`` in, ``lax.complex`` out).
 
 The mode is read ONCE at first use and cached for determinism —
 flipping the env var after any transform has run is ignored (jit
@@ -81,7 +87,7 @@ import jax.numpy as jnp
 
 __all__ = ["fft", "ifft", "rfft", "irfft", "fft_mode", "set_fft_mode",
            "use_matmul_fft", "resolved_mode", "fft_planes",
-           "ifft_planes", "rfft_planes", "irfft_planes"]
+           "ifft_planes", "rfft_planes", "irfft_planes", "plane_dtype"]
 
 _mode_cache: str | None = None  # resolved mode ("xla"/"matmul"/"planar")
 _base_cache: int | None = None  # resolved direct-GEMM base length
@@ -256,10 +262,15 @@ def _best_split(n: int) -> int:
     return 1
 
 
-def _complex_dtype(x):
-    return jnp.complex64 if x.dtype in (jnp.complex64, jnp.float32,
-                                        jnp.bfloat16, jnp.float16) \
+def _complex_dtype_of(dtype):
+    return jnp.complex64 if np.dtype(dtype) in (
+        np.dtype(np.complex64), np.dtype(np.float32),
+        np.dtype(jnp.bfloat16), np.dtype(np.float16)) \
         else jnp.complex128
+
+
+def _complex_dtype(x):
+    return _complex_dtype_of(x.dtype)
 
 
 @lru_cache(maxsize=128)
@@ -368,6 +379,16 @@ def _matmul_fft_1d(x: jax.Array, n, axis: int, sign: float,
 def _plane_dtype(dtype) -> str:
     return "float64" if np.dtype(dtype) in (np.complex128, np.float64) \
         else "float32"
+
+
+def plane_dtype(dtype) -> str:
+    """The REAL dtype of the (re, im) planes the planar engine uses for
+    an input of ``dtype`` — derived from the same complex promotion the
+    complex engine applies (``_complex_dtype``), so int/bool/f64 inputs
+    get float64 planes exactly where x64 ``jnp.fft`` would produce
+    complex128, and f32/bf16/f16/c64 get float32 planes. Distributed
+    plane-pair kernels (``ops/fft.py``) size their buffers with this."""
+    return _plane_dtype(_complex_dtype_of(dtype))
 
 
 @lru_cache(maxsize=128)
@@ -489,7 +510,10 @@ def fft_planes(xr, xi, n=None, axis: int = -1, norm=None, *,
     conventions) without any complex dtype on device."""
     xr = jnp.asarray(xr)
     xi = jnp.zeros_like(xr) if xi is None else jnp.asarray(xi)
-    pdt = _plane_dtype(jnp.result_type(xr.dtype, xi.dtype))
+    # promote via the complex result type (plane_dtype), NOT the raw
+    # storage dtype: int64/bool planes must land on float64 exactly
+    # where x64 jnp.fft would produce complex128
+    pdt = plane_dtype(jnp.result_type(xr.dtype, xi.dtype))
     xr, xi = xr.astype(pdt), xi.astype(pdt)
     if n is not None:
         xr = _pad_trunc_plane(xr, n, axis)
@@ -528,7 +552,7 @@ def rfft_planes(x, n=None, axis: int = -1, norm=None):
     if jnp.iscomplexobj(x):  # numpy allows it; run the full transform
         # on the planes directly — no complex-dtype device ops even on
         # this fallback (the boundary real/imag pair is all it needs)
-        pdt = _plane_dtype(x.dtype)
+        pdt = plane_dtype(x.dtype)
         nn = x.shape[axis] if n is None else n
         yr, yi = fft_planes(jnp.real(x).astype(pdt),
                             jnp.imag(x).astype(pdt),
@@ -537,7 +561,7 @@ def rfft_planes(x, n=None, axis: int = -1, norm=None):
         return (jax.lax.slice_in_dim(yr, 0, keep, axis=axis),
                 jax.lax.slice_in_dim(yi, 0, keep, axis=axis))
     nn = x.shape[axis] if n is None else n
-    pdt = _plane_dtype(x.dtype)
+    pdt = plane_dtype(x.dtype)
     x = x.astype(pdt)
     if nn % 2 or nn < 4:
         yr, yi = fft_planes(x, None, n=nn, axis=axis, norm=norm)
@@ -566,7 +590,7 @@ def irfft_planes(xr, xi, n=None, axis: int = -1, norm=None):
     """Inverse of :func:`rfft_planes`: half-spectrum planes in, REAL
     array out (``jnp.fft.irfft`` semantics)."""
     xr, xi = jnp.asarray(xr), jnp.asarray(xi)
-    pdt = _plane_dtype(jnp.result_type(xr.dtype, xi.dtype))
+    pdt = plane_dtype(jnp.result_type(xr.dtype, xi.dtype))
     xr, xi = xr.astype(pdt), xi.astype(pdt)
     nh = xr.shape[axis]
     nn = 2 * (nh - 1) if n is None else n
@@ -676,7 +700,7 @@ def irfft(x, n=None, axis: int = -1, norm=None):
     if mode == "xla":
         return jnp.fft.irfft(x, n=n, axis=axis, norm=norm)
     if mode == "planar":
-        pdt = _plane_dtype(x.dtype)
+        pdt = plane_dtype(x.dtype)
         xr = jnp.real(x).astype(pdt)
         xi = (jnp.imag(x).astype(pdt) if jnp.iscomplexobj(x)
               else jnp.zeros_like(xr))

@@ -18,6 +18,22 @@ compiler scheduling the transposes. Local transforms go through
 ``ops/dft.py`` — XLA's native FFT or the matmul (MXU) DFT engine for
 TPU runtimes without an FFT custom-call (fftshift/ifftshift are plain
 rolls and stay on ``jnp.fft``).
+
+Planar (complex-free) execution: when the resolved ``fft_mode`` is
+``planar`` — what ``auto`` picks on TPU runtimes with no complex
+lowering at all (round-5 hardware finding) — the aligned pencil
+schedule runs on REAL (re, im) plane pairs end to end: local
+transforms call ``dft.fft_planes``/``rfft_planes``/..., each pencil
+transpose is ONE stacked real ``all_to_all``
+(``parallel.collectives.plane_all_to_all``), and complex dtypes appear
+only as ``real``/``imag``/``lax.complex`` representation ops at the
+user-facing matvec boundary. Plane-aware callers use
+:meth:`_MPIBaseFFTND.matvec_planes` / ``rmatvec_planes`` and get a
+program with zero complex-dtype ops, collectives included (pinned by
+``tests/test_fft.py::test_planar_pencil_hlo_complex_free``). For real
+transforms the all-to-all carries the half-spectrum as two f32 planes
+— about half the bytes of the complex engine's full-spectrum c64
+schedule (``pencil_fft2d_planar`` bench row).
 """
 
 from __future__ import annotations
@@ -167,7 +183,12 @@ class _MPIBaseFFTND(MPILinearOperator):
         hi = 1 + (self.nffts[-1] - 1) // 2
         fac = 1 / np.sqrt(2) if inverse else np.sqrt(2)
         ar = jnp.arange(y.shape[ax])
-        vec = jnp.where((ar >= 1) & (ar < hi), fac, 1.0)
+        # pin the mask vector to y's real dtype: a strong f64 vector
+        # (np.sqrt gives float64) would silently promote the whole
+        # pencil — c64→c128, f32 planes→f64 — right before the
+        # all-to-all, doubling the transpose bytes under x64
+        rdt = np.real(np.ones(1, dtype=y.dtype)).dtype
+        vec = jnp.where((ar >= 1) & (ar < hi), fac, 1.0).astype(rdt)
         shape = [1] * y.ndim
         shape[ax] = y.shape[ax]
         return y * vec.reshape(shape)
@@ -308,7 +329,9 @@ class _MPIBaseFFTND(MPILinearOperator):
         """in_axis==0 pencil schedule, one shard_map kernel end to end:
         per-block stage-1 transforms, all-to-all transpose, axis-0
         transform, all-to-all back."""
-        from jax import shard_map
+        if dft.resolved_mode() == "planar":
+            return self._matvec_aligned_planar(x)
+        from ..jaxcompat import shard_map
         from jax.sharding import PartitionSpec as PSpec
 
         axes = [int(a) for a in self.axes]
@@ -379,7 +402,9 @@ class _MPIBaseFFTND(MPILinearOperator):
                                self.cdtype)
 
     def _rmatvec_aligned(self, x: DistributedArray) -> DistributedArray:
-        from jax import shard_map
+        if dft.resolved_mode() == "planar":
+            return self._rmatvec_aligned_planar(x)
+        from ..jaxcompat import shard_map
         from jax.sharding import PartitionSpec as PSpec
 
         axes = [int(a) for a in self.axes]
@@ -450,6 +475,348 @@ class _MPIBaseFFTND(MPILinearOperator):
                         out_specs=PSpec(axis_name), check_vma=False)(phys)
         dtype = self.rdtype if not self.clinear else self.cdtype
         return self._wrap_flat(out, dims, self._mlocals, x.mesh, dtype)
+
+    # ----------------------------------------- planar (plane-pair) path
+    # The aligned pencil schedule on REAL (re, im) plane pairs: local
+    # transforms through dft.fft_planes/rfft_planes/irfft_planes, each
+    # pencil transpose ONE stacked real all-to-all (plane_all_to_all),
+    # no complex dtype anywhere inside the shard_map program — built
+    # for TPU runtimes with no complex lowering at all (ops/dft.py
+    # module docstring, round-5 hardware finding). The complex-facing
+    # matvec/rmatvec convert with real/imag/lax.complex at the user
+    # boundary only; plane-aware callers (matvec_planes/rmatvec_planes)
+    # get a fully complex-free compiled program.
+
+    def _planes_path_ok(self) -> bool:
+        return (len(self.dims_nd) > 1 and self._in_axis == 0
+                and len(self.mesh.axis_names) == 1)
+
+    @staticmethod
+    def _block_transpose_planes(br, bi, axis_name: str, P: int,
+                                out_ax: int):
+        """Planar :meth:`_block_transpose`: pad ``out_ax`` to a device
+        multiple on both planes, then ONE stacked all-to-all."""
+        from ..parallel.collectives import plane_all_to_all
+        bo = -(-br.shape[out_ax] // P)
+        tail = P * bo - br.shape[out_ax]
+        if tail:
+            padw = [(0, 0)] * br.ndim
+            padw[out_ax] = (0, tail)
+            br, bi = jnp.pad(br, padw), jnp.pad(bi, padw)
+        if P > 1:
+            br, bi = plane_all_to_all(br, bi, axis_name,
+                                      split_axis=out_ax, concat_axis=0)
+        return br, bi
+
+    def _planes_fwd_phys(self, xr: jax.Array, xi: Optional[jax.Array]):
+        """Planar forward pencil on row-aligned flat PHYSICAL plane
+        buffers (``xi`` None = zero imaginary plane, no buffer ever
+        materialized for it); returns the flat (yr, yi) data-side
+        planes. Mirrors the complex kernel of :meth:`_matvec_aligned`
+        stage for stage."""
+        from ..jaxcompat import shard_map
+        from jax.sharding import PartitionSpec as PSpec
+        from ..parallel.collectives import plane_all_to_all
+
+        axes = [int(a) for a in self.axes]
+        shift_before = self._shift_axes(self.ifftshift_before)
+        shift_after = self._shift_axes(self.fftshift_after)
+        P = int(self.mesh.devices.size)
+        axis_name = self.mesh.axis_names[0]
+        out_ax = self._out_axis
+        rows_m, rows_d = self._rows_m, self._rows_d
+        rmax_m, rmax_d = max(rows_m), max(rows_d)
+        dims, dimsd = self.dims_nd, self.dimsd_nd
+        nfft0 = self.nffts[axes.index(0)] if 0 in axes else None
+        stage1 = [axes[-1]] + [a for a in axes[:-1] if a != 0]
+        rows_m_arr = jnp.asarray(rows_m)
+        unpad_m = jnp.asarray(unpad_index_map(rows_m, rmax_m))
+        pad_d_src, pad_d_valid = pad_index_map(rows_d, rmax_d)
+        pad_d_src = jnp.asarray(pad_d_src)
+        pad_d_mask = jnp.asarray(pad_d_valid)
+        pdt = dft.plane_dtype(self.cdtype)
+
+        def kernel(*planes):
+            br = planes[0].reshape((rmax_m,) + tuple(dims[1:]))
+            bi = (planes[1].reshape(br.shape) if len(planes) > 1
+                  else None)
+            nrows = rows_m_arr[lax.axis_index(axis_name)]
+            row = lax.broadcasted_iota(jnp.int32, br.shape, 0)
+
+            def scrub(p):
+                return jnp.where(row < nrows, p,
+                                 jnp.zeros((), dtype=p.dtype))
+
+            br = scrub(br)
+            bi = scrub(bi) if bi is not None else None
+            loc_before = [a for a in shift_before if a != 0]
+            if loc_before:
+                br = jnp.fft.ifftshift(br, axes=loc_before)
+                if bi is not None:
+                    bi = jnp.fft.ifftshift(bi, axes=loc_before)
+            if not self.clinear:
+                bi = None  # the complex kernel's b.real
+            for ax in stage1:
+                nfft = self.nffts[axes.index(ax)]
+                if self.real and ax == axes[-1]:
+                    br, bi = dft.rfft_planes(br, n=nfft, axis=ax)
+                else:
+                    br, bi = dft.fft_planes(br, bi, n=nfft, axis=ax)
+            if self.real:
+                br = self._scale_real(br, inverse=False)
+                bi = self._scale_real(bi, inverse=False)
+            if 0 in axes:
+                br, bi = self._block_transpose_planes(br, bi, axis_name,
+                                                      P, out_ax)
+                br = jnp.take(br, unpad_m, axis=0)     # exact dims[0]
+                bi = jnp.take(bi, unpad_m, axis=0)
+                if 0 in shift_before:
+                    br = jnp.fft.ifftshift(br, axes=(0,))
+                    bi = jnp.fft.ifftshift(bi, axes=(0,))
+                br, bi = dft.fft_planes(br, bi, n=nfft0, axis=0)
+                if 0 in shift_after:
+                    br = jnp.fft.fftshift(br, axes=(0,))
+                    bi = jnp.fft.fftshift(bi, axes=(0,))
+                br = jnp.take(br, pad_d_src, axis=0)   # per-shard padded
+                bi = jnp.take(bi, pad_d_src, axis=0)
+                m = pad_d_mask.reshape((-1,) + (1,) * (br.ndim - 1))
+                br = jnp.where(m, br, jnp.zeros((), dtype=br.dtype))
+                bi = jnp.where(m, bi, jnp.zeros((), dtype=bi.dtype))
+                if P > 1:
+                    br, bi = plane_all_to_all(br, bi, axis_name,
+                                              split_axis=0,
+                                              concat_axis=out_ax)
+                sl = [slice(None)] * br.ndim
+                sl[out_ax] = slice(0, dimsd[out_ax])   # crop tail pad
+                br, bi = br[tuple(sl)], bi[tuple(sl)]
+            loc_after = [a for a in shift_after if a != 0]
+            if loc_after:
+                br = jnp.fft.fftshift(br, axes=loc_after)
+                bi = jnp.fft.fftshift(bi, axes=loc_after)
+            if self.norm == "1/n":
+                br, bi = br / self._scale, bi / self._scale
+            return (br.astype(pdt).reshape(-1),
+                    bi.astype(pdt).reshape(-1))
+
+        planes = (xr,) if xi is None else (xr, xi)
+        spec = PSpec(axis_name)
+        return shard_map(kernel, mesh=self.mesh,
+                         in_specs=(spec,) * len(planes),
+                         out_specs=(spec, spec),
+                         check_vma=False)(*planes)
+
+    def _planes_adj_phys(self, xr: jax.Array, xi: Optional[jax.Array]):
+        """Planar adjoint pencil on flat physical plane buffers;
+        returns a 1-tuple (real-model operators) or 2-tuple of flat
+        model-side planes. Mirrors :meth:`_rmatvec_aligned`."""
+        from ..jaxcompat import shard_map
+        from jax.sharding import PartitionSpec as PSpec
+        from ..parallel.collectives import plane_all_to_all
+
+        axes = [int(a) for a in self.axes]
+        shift_before = self._shift_axes(self.ifftshift_before)
+        shift_after = self._shift_axes(self.fftshift_after)
+        P = int(self.mesh.devices.size)
+        axis_name = self.mesh.axis_names[0]
+        out_ax = self._out_axis
+        rows_m, rows_d = self._rows_m, self._rows_d
+        rmax_m, rmax_d = max(rows_m), max(rows_d)
+        dims, dimsd = self.dims_nd, self.dimsd_nd
+        nfft0 = self.nffts[axes.index(0)] if 0 in axes else None
+        rows_d_arr = jnp.asarray(rows_d)
+        unpad_d = jnp.asarray(unpad_index_map(rows_d, rmax_d))
+        pad_m_src, pad_m_valid = pad_index_map(rows_m, rmax_m)
+        pad_m_src = jnp.asarray(pad_m_src)
+        pad_m_mask = jnp.asarray(pad_m_valid)
+        out_dt = self.rdtype if not self.clinear else self.cdtype
+        pdt = dft.plane_dtype(out_dt)
+
+        def kernel(*planes):
+            br = planes[0].reshape((rmax_d,) + tuple(dimsd[1:]))
+            bi = (planes[1].reshape(br.shape) if len(planes) > 1
+                  else None)
+            nrows = rows_d_arr[lax.axis_index(axis_name)]
+            row = lax.broadcasted_iota(jnp.int32, br.shape, 0)
+
+            def scrub(p):
+                return jnp.where(row < nrows, p,
+                                 jnp.zeros((), dtype=p.dtype))
+
+            br = scrub(br)
+            bi = scrub(bi) if bi is not None else None
+            loc_after = [a for a in shift_after if a != 0]
+            if loc_after:
+                br = jnp.fft.ifftshift(br, axes=loc_after)
+                if bi is not None:
+                    bi = jnp.fft.ifftshift(bi, axes=loc_after)
+            if self.real:
+                br = self._scale_real(br, inverse=True)
+                if bi is not None:
+                    bi = self._scale_real(bi, inverse=True)
+            if 0 in axes:
+                if bi is None:  # axis-0 transform mixes both planes
+                    bi = jnp.zeros_like(br)
+                br, bi = self._block_transpose_planes(br, bi, axis_name,
+                                                      P, out_ax)
+                br = jnp.take(br, unpad_d, axis=0)     # exact dimsd[0]
+                bi = jnp.take(bi, unpad_d, axis=0)
+                if 0 in shift_after:
+                    br = jnp.fft.ifftshift(br, axes=(0,))
+                    bi = jnp.fft.ifftshift(bi, axes=(0,))
+                br, bi = dft.ifft_planes(br, bi, n=nfft0, axis=0)
+                br, bi = br[:dims[0]], bi[:dims[0]]
+                if 0 in shift_before:
+                    br = jnp.fft.fftshift(br, axes=(0,))
+                    bi = jnp.fft.fftshift(bi, axes=(0,))
+                br = jnp.take(br, pad_m_src, axis=0)   # per-shard padded
+                bi = jnp.take(bi, pad_m_src, axis=0)
+                m = pad_m_mask.reshape((-1,) + (1,) * (br.ndim - 1))
+                br = jnp.where(m, br, jnp.zeros((), dtype=br.dtype))
+                bi = jnp.where(m, bi, jnp.zeros((), dtype=bi.dtype))
+                if P > 1:
+                    br, bi = plane_all_to_all(br, bi, axis_name,
+                                              split_axis=0,
+                                              concat_axis=out_ax)
+                sl = [slice(None)] * br.ndim
+                sl[out_ax] = slice(0, dimsd[out_ax])   # crop tail pad
+                br, bi = br[tuple(sl)], bi[tuple(sl)]
+            for ax in [a for a in axes[:-1] if a != 0][::-1]:
+                br, bi = dft.ifft_planes(br, bi,
+                                         n=self.nffts[axes.index(ax)],
+                                         axis=ax)
+            if self.real:
+                if bi is None:
+                    bi = jnp.zeros_like(br)
+                br = dft.irfft_planes(br, bi, n=self.nffts[-1],
+                                      axis=axes[-1])
+                bi = None
+            else:
+                br, bi = dft.ifft_planes(br, bi, n=self.nffts[-1],
+                                         axis=axes[-1])
+            crop = (slice(None),) + tuple(slice(0, d) for d in dims[1:])
+            br = br[crop]
+            bi = bi[crop] if bi is not None else None
+            if self.norm == "none":
+                br = br * self._scale  # cancel ifft's 1/N: true adjoint
+                if bi is not None:
+                    bi = bi * self._scale
+            if not self.clinear:
+                bi = None  # the complex kernel's b.real
+            loc_before = [a for a in shift_before if a != 0]
+            if loc_before:
+                br = jnp.fft.fftshift(br, axes=loc_before)
+                if bi is not None:
+                    bi = jnp.fft.fftshift(bi, axes=loc_before)
+            if bi is None:
+                return (br.astype(pdt).reshape(-1),)
+            return (br.astype(pdt).reshape(-1),
+                    bi.astype(pdt).reshape(-1))
+
+        planes = (xr,) if xi is None else (xr, xi)
+        spec = PSpec(axis_name)
+        n_out = 1 if not self.clinear else 2
+        return shard_map(kernel, mesh=self.mesh,
+                         in_specs=(spec,) * len(planes),
+                         out_specs=(spec,) * n_out,
+                         check_vma=False)(*planes)
+
+    def _matvec_aligned_planar(self, x: DistributedArray) -> DistributedArray:
+        """Complex-facing forward over the planar pencil: split into
+        (re, im) planes at the user boundary, run the complex-free
+        plane program, materialize the output with one ``lax.complex``
+        — the only complex-dtype ops in the apply are these boundary
+        representation ops (plane-aware callers use
+        :meth:`matvec_planes` and skip even those)."""
+        pdt = dft.plane_dtype(self.cdtype)
+        phys = self._aligned_phys(x, self.dims_nd, self._rows_m)
+        if jnp.iscomplexobj(phys):
+            xr = jnp.real(phys).astype(pdt)
+            xi = jnp.imag(phys).astype(pdt)
+        else:
+            xr, xi = phys.astype(pdt), None
+        yr, yi = self._planes_fwd_phys(xr, xi)
+        return self._wrap_flat(lax.complex(yr, yi), self.dimsd_nd,
+                               self._dlocals, x.mesh, self.cdtype)
+
+    def _rmatvec_aligned_planar(self, x: DistributedArray) -> DistributedArray:
+        pdt = dft.plane_dtype(self.cdtype)
+        phys = self._aligned_phys(x, self.dimsd_nd, self._rows_d)
+        if jnp.iscomplexobj(phys):
+            xr = jnp.real(phys).astype(pdt)
+            xi = jnp.imag(phys).astype(pdt)
+        else:
+            xr, xi = phys.astype(pdt), None
+        planes = self._planes_adj_phys(xr, xi)
+        dt = self.rdtype if not self.clinear else self.cdtype
+        out = planes[0] if len(planes) == 1 else lax.complex(*planes)
+        return self._wrap_flat(out, self.dims_nd, self._mlocals, x.mesh,
+                               dt)
+
+    def matvec_planes(self, xr: DistributedArray,
+                      xi: Optional[DistributedArray] = None):
+        """Plane-pair forward apply: REAL (re, im) flat DistributedArray
+        planes in, plane-pair DistributedArrays out. The compiled
+        program contains NO complex dtype anywhere — collectives
+        included — which is what FFT-less/complex-less TPU runtimes and
+        plane-aware operator chains consume (pinned by
+        ``tests/test_fft.py::test_planar_pencil_hlo_complex_free``).
+        Runs the planar engine regardless of the resolved mode.
+        ``xi=None`` means a zero imaginary plane (required for
+        ``real=True`` operators, whose model is real). Requires the
+        aligned pencil path (ndim > 1, single-axis mesh, in_axis==0)."""
+        self._check_planes_args(xr, xi, self.shape[1])
+        if self.real and xi is not None:
+            raise ValueError("real=True operators take a real model: "
+                             "pass xi=None")
+        pdt = dft.plane_dtype(self.cdtype)
+        pr = self._aligned_phys(xr, self.dims_nd,
+                                self._rows_m).astype(pdt)
+        pi = (None if xi is None else
+              self._aligned_phys(xi, self.dims_nd,
+                                 self._rows_m).astype(pdt))
+        yr, yi = self._planes_fwd_phys(pr, pi)
+        return (self._wrap_flat(yr, self.dimsd_nd, self._dlocals,
+                                xr.mesh, pdt),
+                self._wrap_flat(yi, self.dimsd_nd, self._dlocals,
+                                xr.mesh, pdt))
+
+    def rmatvec_planes(self, xr: DistributedArray,
+                       xi: Optional[DistributedArray] = None):
+        """Plane-pair adjoint apply (see :meth:`matvec_planes`);
+        returns ``(yr, None)`` for real-model operators, whose adjoint
+        output is a single real plane."""
+        self._check_planes_args(xr, xi, self.shape[0])
+        pdt = dft.plane_dtype(self.cdtype)
+        pr = self._aligned_phys(xr, self.dimsd_nd,
+                                self._rows_d).astype(pdt)
+        pi = (None if xi is None else
+              self._aligned_phys(xi, self.dimsd_nd,
+                                 self._rows_d).astype(pdt))
+        planes = self._planes_adj_phys(pr, pi)
+        out_dt = self.rdtype if not self.clinear else self.cdtype
+        pdt_out = dft.plane_dtype(out_dt)
+        yr = self._wrap_flat(planes[0], self.dims_nd, self._mlocals,
+                             xr.mesh, pdt_out)
+        yi = (self._wrap_flat(planes[1], self.dims_nd, self._mlocals,
+                              xr.mesh, pdt_out)
+              if len(planes) > 1 else None)
+        return yr, yi
+
+    def _check_planes_args(self, xr, xi, n: int) -> None:
+        if not self._planes_path_ok():
+            raise NotImplementedError(
+                "plane-pair apply requires the aligned pencil path "
+                "(ndim > 1 with a single-axis mesh and in_axis == 0)")
+        for p in (xr, xi):
+            if p is None:
+                continue
+            if p.partition != Partition.SCATTER:
+                raise ValueError(f"planes should have partition="
+                                 f"{Partition.SCATTER} Got {p.partition}"
+                                 " instead...")
+            if p.global_shape != (n,):
+                raise ValueError(f"plane global shape {p.global_shape} "
+                                 f"!= expected ({n},)")
 
     def _matvec_generic(self, x: DistributedArray) -> DistributedArray:
         """General pencil schedule on the logical global array (1-D

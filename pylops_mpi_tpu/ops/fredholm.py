@@ -51,17 +51,46 @@ class MPIFredholm1(MPILinearOperator):
     and accumulation stay in the operator dtype (the
     ``MPIBlockDiag(compute_dtype=...)`` HBM-bandwidth lever; the
     reference's engine has no narrow-storage path).
+
+    ``planar=True``: the complex-free execution mode for TPU runtimes
+    with no complex lowering (round-5 hardware finding, ops/dft.py).
+    The complex kernel ``G`` is stored as a STACKED REAL plane pair
+    ``(2, nsl, nx, ny)`` (``[0]`` real, ``[1]`` imag, slice axis still
+    sharded), model/data vectors carry the matching ``(2, nsl, ·, nz)``
+    plane layout, the operator dtype is the real plane dtype, and each
+    complex batched GEMM runs as 4 real einsums — no complex dtype ever
+    reaches the device. This is the Fredholm core of the planar MDC
+    chain (``ops/mdc.py``); only BROADCAST vectors are supported (the
+    zero-collective slice-aligned SCATTER layout is a flat-vector
+    contract that the leading plane axis breaks).
     """
 
     def __init__(self, G, nz: int = 1, saveGt: bool = False,
                  usematmul: bool = True, mesh=None, dtype="float64",
-                 compute_dtype=None):
+                 compute_dtype=None, planar: bool = False):
         G = jnp.asarray(G)
+        self.planar = bool(planar)
+        if self.planar:
+            # planes store the REAL representation: a complex
+            # compute_dtype narrows to its real counterpart
+            if compute_dtype is not None and \
+                    np.issubdtype(np.dtype(compute_dtype),
+                                  np.complexfloating):
+                compute_dtype = np.real(
+                    np.ones(1, dtype=compute_dtype)).dtype
+            if np.issubdtype(np.dtype(dtype), np.complexfloating):
+                dtype = np.real(np.ones(1, dtype=np.dtype(dtype))).dtype
         self.compute_dtype = compute_dtype
+        self.nz = int(nz)
+        if self.planar:
+            pdt = np.real(np.ones(1, dtype=G.dtype)).dtype
+            G = jnp.stack([jnp.real(G).astype(pdt),
+                           jnp.imag(G).astype(pdt)])
+            self.nsl, self.nx, self.ny = G.shape[1:]
+        else:
+            self.nsl, self.nx, self.ny = G.shape
         if compute_dtype is not None:
             G = G.astype(compute_dtype)
-        self.nz = int(nz)
-        self.nsl, self.nx, self.ny = G.shape
         from ..parallel.mesh import default_mesh
         self.mesh = mesh if mesh is not None else default_mesh()
         # the reference forbids shards with < 2 slices
@@ -70,16 +99,25 @@ class MPIFredholm1(MPILinearOperator):
         # nsl >= 1 is accepted
         if self.nsl < 1:
             raise ValueError("G must have at least one slice")
-        self.dims = (self.nsl, self.ny, self.nz)
-        self.dimsd = (self.nsl, self.nx, self.nz)
+        plead = (2,) if self.planar else ()
+        self.dims = plead + (self.nsl, self.ny, self.nz)
+        self.dimsd = plead + (self.nsl, self.nx, self.nz)
         super().__init__(shape=(int(np.prod(self.dimsd)),
                                 int(np.prod(self.dims))),
                          dtype=np.dtype(dtype))
         try:
-            self.G = jax.device_put(G, axis_sharding(self.mesh, 3, 0))
+            self.G = jax.device_put(
+                G, axis_sharding(self.mesh, G.ndim, len(plead)))
         except ValueError:
             self.G = G
-        self.GT = jnp.conj(G.transpose(0, 2, 1)) if saveGt else None
+        if not saveGt:
+            self.GT = None
+        elif self.planar:
+            # conj-transpose planes: (Grᵀ, -Giᵀ) per slice
+            self.GT = jnp.stack([G[0].transpose(0, 2, 1),
+                                 -G[1].transpose(0, 2, 1)])
+        else:
+            self.GT = jnp.conj(G.transpose(0, 2, 1))
         self._ndev = int(self.mesh.devices.size)
 
     @property
@@ -95,8 +133,10 @@ class MPIFredholm1(MPILinearOperator):
         return self._slice_shapes(self.nx)
 
     def _slice_shapes(self, inner):
-        if self.nsl % self._ndev != 0:
+        if self.planar or self.nsl % self._ndev != 0:
             # must match G's even NamedSharding for the zero-comm path
+            # (planar: the leading plane axis breaks the flat
+            # slice-aligned layout — BROADCAST only)
             return None
         from ..parallel.partition import flat_outer_shapes
         return flat_outer_shapes(self.nsl, inner * self.nz, self._ndev)
@@ -112,8 +152,8 @@ class MPIFredholm1(MPILinearOperator):
         raise ValueError(
             "x must be BROADCAST, or SCATTER with slice-aligned local "
             "shapes (model_local_shapes/data_local_shapes; requires "
-            f"nsl % n_devices == 0); got {x.partition} with local sizes "
-            f"{tuple(x._axis_sizes)}")
+            "nsl % n_devices == 0 and planar=False); got "
+            f"{x.partition} with local sizes {tuple(x._axis_sizes)}")
 
     def _wrap(self, arr, x: DistributedArray, n: int,
               inner: int) -> DistributedArray:
@@ -138,14 +178,37 @@ class MPIFredholm1(MPILinearOperator):
     def _matvec(self, x: DistributedArray) -> DistributedArray:
         self._check_partition(x, self.ny)
         m = x.array.reshape(self.dims)
-        d = self._contract("kxy,kyz->kxz", self.G, m)
+        if self.planar:
+            # complex batched GEMM on plane pairs, 4 real einsums (the
+            # Karatsuba 3-einsum form needs a kernel-sized Gr+Gi temp —
+            # an extra full sweep of the memory hog — so the plain
+            # 4-sweep lowering wins here, unlike the host-folded
+            # constants of ops/dft.py)
+            c = lambda K, v: self._contract("kxy,kyz->kxz", K, v)
+            dr = c(self.G[0], m[0]) - c(self.G[1], m[1])
+            di = c(self.G[0], m[1]) + c(self.G[1], m[0])
+            d = jnp.stack([dr, di])
+        else:
+            d = self._contract("kxy,kyz->kxz", self.G, m)
         return self._wrap(d, x, self.shape[0], self.nx)
 
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
         self._check_partition(x, self.nx)
         d = x.array.reshape(self.dimsd)
-        GT = self.GT if self.GT is not None else jnp.conj(self.G).transpose(0, 2, 1)
-        m = self._contract("kyx,kxz->kyz", GT, d)
+        if self.planar:
+            if self.GT is not None:
+                Hr, Hi = self.GT[0], self.GT[1]
+            else:  # Gᴴ planes: (Grᵀ, -Giᵀ) per slice
+                Hr = self.G[0].transpose(0, 2, 1)
+                Hi = -self.G[1].transpose(0, 2, 1)
+            c = lambda K, v: self._contract("kyx,kxz->kyz", K, v)
+            mr = c(Hr, d[0]) - c(Hi, d[1])
+            mi = c(Hr, d[1]) + c(Hi, d[0])
+            m = jnp.stack([mr, mi])
+        else:
+            GT = self.GT if self.GT is not None \
+                else jnp.conj(self.G).transpose(0, 2, 1)
+            m = self._contract("kyx,kxz->kyz", GT, d)
         return self._wrap(m, x, self.shape[1], self.ny)
 
 

@@ -563,16 +563,28 @@ class FFT(LocalOperator):
     ``real=True``, the √2 scaling of strictly-positive non-Nyquist
     frequencies that makes the half-spectrum operator an isometry (and
     its adjoint pass the dot test) — the same convention the reference's
-    distributed FFT preserves (ref ``signalprocessing/FFTND.py:278-309``)."""
+    distributed FFT preserves (ref ``signalprocessing/FFTND.py:278-309``).
+
+    ``planes=True`` (requires ``real=True``): the half-spectrum leaves
+    as a STACKED REAL plane pair — data layout ``(2,) + dimsd`` with
+    ``[0]`` the real and ``[1]`` the imaginary plane, operator dtype
+    the real plane dtype — computed via ``dft.rfft_planes`` /
+    ``irfft_planes`` so no complex dtype ever reaches the device. This
+    is the local transform of the planar MDC chain (``ops/mdc.py``) on
+    TPU runtimes without complex lowering."""
 
     def __init__(self, dims, axis: int = 0, nfft: Optional[int] = None,
                  real: bool = True, ifftshift_before: bool = False,
-                 dtype=None):
+                 dtype=None, planes: bool = False):
         dims = tuple(np.atleast_1d(dims))
         self.dims_nd = dims
         self.axis = axis % len(dims)
         self.nfft = nfft or dims[self.axis]
         self.real = real
+        self.planes = bool(planes)
+        if self.planes and not real:
+            raise ValueError("planes=True requires real=True (the "
+                             "plane-pair half-spectrum layout)")
         self.ifftshift_before = bool(ifftshift_before)
         nf = self.nfft // 2 + 1 if real else self.nfft
         dimsd = list(dims)
@@ -580,6 +592,12 @@ class FFT(LocalOperator):
         self.dimsd_nd = tuple(dimsd)
         # bins 1..nf-1 except the Nyquist bin of an even nfft
         self._double_hi = nf - 1 if self.nfft % 2 == 0 else nf
+        if self.planes:
+            pdt = np.float32 \
+                if np.dtype(dtype or "float32").itemsize == 4 \
+                else np.float64
+            super().__init__(dims, (2,) + self.dimsd_nd, dtype=pdt)
+            return
         cplx = np.complex64 if np.dtype(dtype or "float32").itemsize == 4 else np.complex128
         super().__init__(dims, self.dimsd_nd, dtype=cplx)
 
@@ -597,6 +615,12 @@ class FFT(LocalOperator):
         v = x.reshape(self.dims_nd)
         if self.ifftshift_before:
             v = jnp.fft.ifftshift(v, axes=self.axis)
+        if self.planes:
+            yr, yi = dft.rfft_planes(v, n=self.nfft, axis=self.axis,
+                                     norm="ortho")
+            yr = self._scale_pos(yr, np.sqrt(2.0))
+            yi = self._scale_pos(yi, np.sqrt(2.0))
+            return jnp.stack([yr, yi]).astype(self.dtype).ravel()
         if self.real:
             y = dft.rfft(v.real, n=self.nfft, axis=self.axis, norm="ortho")
             y = self._scale_pos(y, np.sqrt(2.0))
@@ -605,20 +629,27 @@ class FFT(LocalOperator):
         return y.ravel()
 
     def _rmatvec(self, x):
-        v = x.reshape(self.dimsd_nd)
-        if self.real:
-            # adjoint of (√2-scaled) rfft: halve the doubled bins and let
-            # irfft's Hermitian extension supply the other half
-            v = self._scale_pos(v, 1.0 / np.sqrt(2.0))
-            y = dft.irfft(v, n=self.nfft, axis=self.axis, norm="ortho")
+        if self.planes:
+            v = x.reshape((2,) + self.dimsd_nd)
+            vr = self._scale_pos(v[0], 1.0 / np.sqrt(2.0))
+            vi = self._scale_pos(v[1], 1.0 / np.sqrt(2.0))
+            y = dft.irfft_planes(vr, vi, n=self.nfft, axis=self.axis,
+                                 norm="ortho")
         else:
-            y = dft.ifft(v, n=self.nfft, axis=self.axis, norm="ortho")
+            v = x.reshape(self.dimsd_nd)
+            if self.real:
+                # adjoint of (√2-scaled) rfft: halve the doubled bins and
+                # let irfft's Hermitian extension supply the other half
+                v = self._scale_pos(v, 1.0 / np.sqrt(2.0))
+                y = dft.irfft(v, n=self.nfft, axis=self.axis, norm="ortho")
+            else:
+                y = dft.ifft(v, n=self.nfft, axis=self.axis, norm="ortho")
         idx = [slice(None)] * len(self.dims_nd)
         idx[self.axis] = slice(0, self.dims_nd[self.axis])
         y = y[tuple(idx)]
         if self.ifftshift_before:
             y = jnp.fft.fftshift(y, axes=self.axis)
-        return y.ravel()
+        return y.astype(self.dtype).ravel() if self.planes else y.ravel()
 
 
 class Conv1D(LocalOperator):

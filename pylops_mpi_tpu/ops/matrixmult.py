@@ -42,7 +42,9 @@ from typing import Optional, Tuple
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ..jaxcompat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..distributedarray import DistributedArray, Partition, local_split

@@ -6,6 +6,19 @@ applied to the replicated model/data (wrapped local operators,
 ref ``MDC.py:55-58``), I/I1 slice to the first ``nfmax`` frequencies,
 and the frequency-sharded :class:`MPIFredholm1` is the distributed core.
 Kernel prescaling ``dr·dt·√nt`` (ref ``MDC.py:37-43``).
+
+Engines: the ``complex`` chain carries complex frequency-domain
+vectors between the stages (the reference layout). The ``planar``
+chain — auto-selected when the resolved local-FFT mode is ``planar``,
+i.e. on TPU runtimes with no complex lowering at all (round-5 hardware
+finding, ``ops/dft.py``) — keeps every intermediate as a STACKED REAL
+plane pair: ``local.FFT(planes=True)`` produces ``(2, nfft, ·, nv)``
+half-spectrum planes via ``dft.rfft_planes``, the frequency slice is a
+plane-aware pad/crop, and ``MPIFredholm1(planar=True)`` contracts the
+kernel as stored (re, im) planes — so the compiled end-to-end MDC
+program contains no complex dtype anywhere (model and data are real
+time-domain vectors on both ends in either engine; shapes and numerics
+match the complex chain to plane precision).
 """
 
 from __future__ import annotations
@@ -17,26 +30,55 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..linearoperator import MPILinearOperator, aslinearoperator
+from . import dft
 from .fredholm import MPIFredholm1
-from .local import FFT as _LocalFFT, Identity as _LocalIdentity
+from .local import (FFT as _LocalFFT, FunctionOperator as _LocalFunction,
+                    Identity as _LocalIdentity)
 
 __all__ = ["MPIMDC"]
+
+
+def _plane_freq_slice(nfft: int, nfmax: int, inner: int, dtype):
+    """Plane-aware frequency-slice operator: ``(2, nfft, inner)`` real
+    planes -> first ``nfmax`` frequencies of each plane (adjoint
+    zero-pads back) — the planar analog of the flat-prefix
+    ``local.Identity`` slice the complex chain uses."""
+
+    def f(v):
+        return v.reshape(2, nfft, inner)[:, :nfmax].ravel()
+
+    def fH(v):
+        return jnp.pad(v.reshape(2, nfmax, inner),
+                       ((0, 0), (0, nfft - nfmax), (0, 0))).ravel()
+
+    return _LocalFunction(f, fH, N=2 * nfmax * inner,
+                          M=2 * nfft * inner, dtype=dtype)
 
 
 def MPIMDC(G, nt: int, nv: int, nfreq: Optional[int] = None, dt: float = 1.0,
            dr: float = 1.0, twosided: bool = True, saveGt: bool = True,
            conj: bool = False, prescaled: bool = False, mesh=None,
-           compute_dtype=None) -> MPILinearOperator:
+           compute_dtype=None,
+           engine: Optional[str] = None) -> MPILinearOperator:
     """Distributed MDC operator (ref ``MDC.py:82-180``). ``G`` is the
     full frequency-domain kernel ``(nfmax, ns, nr)`` (one controller —
     the reference passes each rank its frequency chunk).
     ``compute_dtype`` (e.g. ``jnp.complex64``) narrows the stored
     kernel — the operator's memory hog — via
     ``MPIFredholm1(compute_dtype=...)``; FFTs and vectors keep the
-    operator dtype."""
+    operator dtype. ``engine``: ``"complex"`` | ``"planar"`` | None
+    (auto — planar exactly when ``dft.resolved_mode() == "planar"``,
+    the no-complex-lowering TPU case); both engines expose identical
+    external shapes/dtypes (real model in, real data out)."""
     G = jnp.asarray(G)
     if twosided and nt % 2 == 0:
         raise ValueError("nt must be odd number")
+    if engine is None:
+        engine = "planar" if dft.resolved_mode() == "planar" \
+            else "complex"
+    if engine not in ("complex", "planar"):
+        raise ValueError(f"engine must be 'complex', 'planar' or None, "
+                         f"got {engine!r}")
     dtype = G.dtype
     rdtype = np.real(np.ones(1, dtype=dtype)).dtype
     nfmax, ns, nr = G.shape
@@ -50,19 +92,42 @@ def MPIMDC(G, nt: int, nv: int, nfreq: Optional[int] = None, dt: float = 1.0,
         nfmax = nfmax_req
 
     scale = 1.0 if prescaled else dr * dt * np.sqrt(nt)
-    Frop = MPIFredholm1(scale * G, nv, saveGt=saveGt, mesh=mesh,
-                        dtype=dtype, compute_dtype=compute_dtype)
-    if conj:
-        Frop = Frop.conj()
 
-    Fop = aslinearoperator(_LocalFFT((nt, nr, nv), axis=0, real=True,
-                                     ifftshift_before=twosided, dtype=rdtype))
-    F1op = aslinearoperator(_LocalFFT((nt, ns, nv), axis=0, real=True,
-                                      ifftshift_before=False, dtype=rdtype))
-    Iop = aslinearoperator(_LocalIdentity(nfmax * nr * nv, nfft * nr * nv,
-                                          dtype=dtype))
-    I1op = aslinearoperator(_LocalIdentity(nfmax * ns * nv, nfft * ns * nv,
-                                           dtype=dtype))
+    if engine == "planar":
+        # conj folds into the stored kernel: Fredholm1.conj() == the
+        # operator with kernel conj(G) (the _ConjLinearOperator wrapper
+        # conjugates vectors, which is an identity on real planes and
+        # would silently do nothing here)
+        Gk = jnp.conj(G) if conj else G
+        Frop = MPIFredholm1(scale * Gk, nv, saveGt=saveGt, mesh=mesh,
+                            dtype=rdtype, compute_dtype=compute_dtype,
+                            planar=True)
+        Fop = aslinearoperator(_LocalFFT(
+            (nt, nr, nv), axis=0, real=True, ifftshift_before=twosided,
+            dtype=rdtype, planes=True))
+        F1op = aslinearoperator(_LocalFFT(
+            (nt, ns, nv), axis=0, real=True, dtype=rdtype, planes=True))
+        Iop = aslinearoperator(_plane_freq_slice(nfft, nfmax, nr * nv,
+                                                 Fop.dtype))
+        I1op = aslinearoperator(_plane_freq_slice(nfft, nfmax, ns * nv,
+                                                  F1op.dtype))
+    else:
+        Frop = MPIFredholm1(scale * G, nv, saveGt=saveGt, mesh=mesh,
+                            dtype=dtype, compute_dtype=compute_dtype)
+        if conj:
+            Frop = Frop.conj()
+        Fop = aslinearoperator(_LocalFFT((nt, nr, nv), axis=0, real=True,
+                                         ifftshift_before=twosided,
+                                         dtype=rdtype))
+        F1op = aslinearoperator(_LocalFFT((nt, ns, nv), axis=0, real=True,
+                                          ifftshift_before=False,
+                                          dtype=rdtype))
+        Iop = aslinearoperator(_LocalIdentity(nfmax * nr * nv,
+                                              nfft * nr * nv,
+                                              dtype=dtype))
+        I1op = aslinearoperator(_LocalIdentity(nfmax * ns * nv,
+                                               nfft * ns * nv,
+                                               dtype=dtype))
     MDCop = F1op.H * I1op.H * Frop * Iop * Fop
     MDCop.dtype = rdtype
     return MDCop

@@ -9,6 +9,9 @@ module holds only the hand-scheduled primitives the hot kernels consume:
 
 - :func:`all_to_all_resharding` — the pencil transpose of the
   distributed FFTs (``ops/fft.py``) and ``redistribute``'s pattern;
+- :func:`plane_all_to_all` — the same pencil transpose on an (re, im)
+  REAL plane pair (one stacked collective), consumed by the planar
+  complex-free FFT mode's shard_map kernels;
 - :func:`ring_halo_extend` / :func:`cart_halo_extend` — in-kernel
   neighbour (ghost-cell) exchanges used by the stencil fast path
   (``ops/derivatives.py``) and the N-D Cartesian halo (``ops/halo.py``).
@@ -32,10 +35,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ..jaxcompat import shard_map
 
 __all__ = [
     "all_to_all_resharding",
+    "plane_all_to_all",
     "ring_halo_extend",
     "cart_halo_extend",
     "halo_slab",
@@ -65,6 +69,31 @@ def all_to_all_resharding(x: jax.Array, mesh: Mesh,
 
     return shard_map(kernel, mesh=mesh, in_specs=P(*in_spec),
                      out_specs=P(*out_spec))(x)
+
+
+def plane_all_to_all(br: jax.Array, bi: jax.Array, axis_name: str, *,
+                     split_axis: int, concat_axis: int):
+    """ONE tiled ``all_to_all`` carrying an (re, im) plane pair, for use
+    *inside* a ``shard_map`` kernel — the pencil-transpose primitive of
+    the planar (complex-free) distributed FFT mode (``ops/fft.py``).
+
+    The planes are stacked on a NEW trailing axis before the exchange,
+    so each frequency bin's (re, im) pair stays on the same shard
+    through the split — splitting a fused re/im layout along the
+    transposed axis would separate the pair members across devices and
+    make the post-transpose per-bin arithmetic impossible. One
+    collective instead of two halves the dispatch count on the
+    latency-bound remote-TPU tunnel; the payload is the two f32 planes,
+    which for the half-spectrum of a real transform is ~half the bytes
+    of the complex engine's full-spectrum c64 schedule.
+
+    ``split_axis``/``concat_axis`` refer to the UNSTACKED plane axes
+    (both must be < ``br.ndim``). Returns the transposed plane pair.
+    """
+    s = jnp.stack([br, bi], axis=-1)
+    s = lax.all_to_all(s, axis_name, split_axis=split_axis,
+                       concat_axis=concat_axis, tiled=True)
+    return s[..., 0], s[..., 1]
 
 
 def cart_halo_extend(block: jax.Array, axis_name: str,

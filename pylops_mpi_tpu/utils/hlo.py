@@ -12,7 +12,10 @@ lowering to a full all-gather instead of boundary ``collective-permute``
 ``collective_report(fn, *args)`` → dict mapping collective kind to
 ``{"count": n, "bytes": total}``; ``assert_no_full_gather(fn, *args,
 max_fraction=...)`` raises if any single all-gather result exceeds the
-given fraction of the largest argument's bytes.
+given fraction of the largest argument's bytes;
+``assert_complex_free(fn, *args)`` raises on any complex-dtype
+instruction — the pin for the planar plane-pair FFT programs on
+runtimes without complex lowering.
 """
 
 from __future__ import annotations
@@ -24,7 +27,8 @@ import numpy as np
 import jax
 
 __all__ = ["collective_report", "assert_no_full_gather",
-           "parse_hlo_collectives"]
+           "parse_hlo_collectives", "complex_dtype_lines",
+           "assert_complex_free"]
 
 # HLO opcode -> canonical name; bytes counted from the result shape
 _COLLECTIVE_OPS = ("all-gather", "all-reduce", "all-to-all",
@@ -107,6 +111,35 @@ def parse_hlo_collectives(hlo: str) -> Dict[str, Dict[str, int]]:
         ent["bytes"] += nbytes
         ent["max_bytes"] = max(ent["max_bytes"], nbytes)
     return report
+
+
+_COMPLEX_TYPE_RE = re.compile(r"\bc(?:64|128)\[")
+
+
+def complex_dtype_lines(hlo: str) -> list:
+    """Every HLO line whose instruction touches a complex dtype (a
+    ``c64[...]``/``c128[...]`` shape anywhere — result or operand)."""
+    return [ln for ln in hlo.splitlines() if _COMPLEX_TYPE_RE.search(ln)]
+
+
+def assert_complex_free(fn, *args, **kwargs):
+    """Compile ``fn(*args, **kwargs)`` and raise ``AssertionError`` if
+    the optimized HLO contains ANY complex-dtype instruction —
+    collectives included. This is the pin for the planar (plane-pair)
+    distributed FFT programs: on TPU runtimes with no complex lowering
+    at all (round-5 hardware finding) a single c64 op anywhere in the
+    program, even a pure representation op, is a runtime
+    ``UNIMPLEMENTED`` that wedges the client. Returns the collective
+    report of the same program for further schedule checks."""
+    jfn = fn if hasattr(fn, "lower") else jax.jit(fn)
+    hlo = jfn.lower(*args, **kwargs).compile().as_text()
+    lines = complex_dtype_lines(hlo)
+    if lines:
+        head = "\n".join(ln.strip()[:160] for ln in lines[:8])
+        raise AssertionError(
+            f"program contains {len(lines)} complex-dtype instruction "
+            f"line(s); first few:\n{head}")
+    return parse_hlo_collectives(hlo)
 
 
 def assert_no_full_gather(fn, *args, max_fraction: float = 0.5, **kwargs):

@@ -152,6 +152,33 @@ def main() -> None:
         / np.linalg.norm(wF))(yF._arr))
     assert ferr < 1e-4, f"FFT rel err {ferr}"
 
+    # planar (complex-free) pencil FFT across processes: the stacked
+    # plane-pair all_to_all (plane_all_to_all) crossing the process
+    # boundary — the multihost dryrun of the mode auto-selected on TPU
+    # runtimes without complex lowering. Plane-aware API first (zero
+    # complex dtypes end to end), then the complex-facing dispatch.
+    from pylops_mpi_tpu.ops import dft as _dft
+    Pr = pmt.DistributedArray.to_dist(
+        xf.real.ravel().astype(np.float32), mesh=flat)
+    Pi = pmt.DistributedArray.to_dist(
+        xf.imag.ravel().astype(np.float32), mesh=flat)
+    pyr, pyi = Fop.matvec_planes(Pr, Pi)
+    perr = float(jax.jit(
+        lambda a, b: jnp.linalg.norm(
+            jnp.stack([a - jnp.asarray(wF.real),
+                       b - jnp.asarray(wF.imag)]))
+        / np.linalg.norm(wF))(pyr._arr, pyi._arr))
+    assert perr < 1e-4, f"planar plane-pair FFT rel err {perr}"
+    _dft.set_fft_mode("planar")
+    try:
+        yP = Fop @ pmt.DistributedArray.to_dist(xf.ravel(), mesh=flat)
+        pferr = float(jax.jit(
+            lambda a: jnp.linalg.norm(a - jnp.asarray(wF))
+            / np.linalg.norm(wF))(yP._arr))
+    finally:
+        _dft.set_fft_mode(None)
+    assert pferr < 1e-4, f"planar FFT rel err {pferr}"
+
     # MPIHalo on a 2-D Cartesian grid spanning both processes: the
     # slab ppermutes AND the diagonal corner relay cross the process
     # boundary (round-4 VERDICT next #7). The halo adjoint is the
@@ -193,7 +220,8 @@ def main() -> None:
 
     print(f"MULTIHOST OK p{pid} cgls_err={err:.2e} summa_err={serr:.2e} "
           f"ista_err={ierr:.2e} stencil_err={derr:.2e} "
-          f"fft_err={ferr:.2e} halo_err={herr:.2e} "
+          f"fft_err={ferr:.2e} planar_fft_err={pferr:.2e} "
+          f"planes_fft_err={perr:.2e} halo_err={herr:.2e} "
           f"halo_energy_err={henerr:.2e}", flush=True)
 
 

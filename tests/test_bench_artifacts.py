@@ -304,3 +304,68 @@ def test_rehearse_never_overwrites_tpu_cache(tmp_path, monkeypatch):
     out = tpl.harvest(dict(cache), rehearse=True)
     assert out["selfcheck"]["result"]["platform"] == "tpu"
     assert out["selfcheck"]["code_rev"] == "old"
+
+
+def test_bisect_all_failed_hardware_window_merged(bench, tmp_path):
+    """A hardware bisect in which EVERY probe died emits no per-probe
+    platform tag (probes only tag platform on success) — that all-fail
+    outcome is the round's evidence and must merge, flagged as such.
+    The round-5 failure being fixed: `plats == {'tpu'}` never held, so
+    the UNIMPLEMENTED map was silently dropped."""
+    root = str(tmp_path)
+    probes = {"fft_1d": {"ok": False, "error": "UNIMPLEMENTED"},
+              "pencil": {"ok": False, "error": "UNIMPLEMENTED"}}
+    _write(root, cache={
+        "bisect": {"result": {"results": probes}, "ts": "t",
+                   "code_rev": "abc"},
+    })
+    out = bench._merge_tpu_cache({"platform": "tpu", "value": 1.0},
+                                 root=root)
+    assert out["tpu_bisect"]["all_probes_failed"] is True
+    assert out["tpu_bisect"]["probes"]["fft_1d"]["error"] == \
+        "UNIMPLEMENTED"
+
+
+def test_bisect_rehearsal_all_failed_not_merged(bench, tmp_path):
+    """The empty-platform acceptance must NOT extend to rehearsal
+    harvests (cpu children, daemon-stamped `rehearse`): an all-fail
+    rehearsal proves nothing about the chip."""
+    root = str(tmp_path)
+    probes = {"fft_1d": {"ok": False, "error": "boom"}}
+    _write(root, cache={
+        "bisect": {"result": {"results": probes}, "rehearse": True},
+    })
+    out = bench._merge_tpu_cache({"platform": "tpu", "value": 1.0},
+                                 root=root)
+    assert "tpu_bisect" not in out
+
+
+def test_bisect_cpu_children_still_not_merged(bench, tmp_path):
+    """Probes that SUCCEEDED on cpu (unstamped rehearsal, or a tunnel
+    drop mid-stage) keep the original hardware-evidence guard."""
+    root = str(tmp_path)
+    probes = {"fft_1d": {"ok": True, "platform": "cpu"}}
+    _write(root, cache={"bisect": {"result": {"results": probes}}})
+    out = bench._merge_tpu_cache({"platform": "tpu", "value": 1.0},
+                                 root=root)
+    assert "tpu_bisect" not in out
+
+
+def test_fft_planar_stage_merged_and_compacted(bench, tmp_path):
+    """The harvest ladder's fft_planar stage (the planar-FFT hardware
+    verdict) merges under the same rules as bisect and surfaces an
+    ok/total verdict in the compact stdout line."""
+    root = str(tmp_path)
+    probes = {"planar_dft_1d": {"ok": True, "platform": "tpu"},
+              "pencil_fft2d_planar": {"ok": True, "platform": "tpu"},
+              "pencil_rfft2d_planar": {"ok": False, "platform": "tpu",
+                                       "error": "err"}}
+    _write(root, cache={
+        "fft_planar": {"result": {"results": probes}, "ts": "t",
+                       "code_rev": "abc"},
+    })
+    out = bench._merge_tpu_cache({"platform": "tpu", "value": 1.0},
+                                 root=root)
+    assert out["tpu_fft_planar"]["platform"] == "tpu"
+    line = bench._compact_line(out)
+    assert line["fft_planar"] == {"ok": 2, "total": 3}

@@ -196,10 +196,13 @@ def test_stage_radices_accounting(monkeypatch):
     assert len(rs) == 2 * len(dft.stage_radices(m))
 
 
-def test_packed_rfft_matches_numpy_all_norms(monkeypatch):
+@pytest.mark.parametrize("mode", ENGINES)
+def test_packed_rfft_matches_numpy_all_norms(mode, monkeypatch):
     """The packed-real path (even n) across every norm, plus the odd-n
-    fallback and n-argument pad/truncate."""
-    _force_matmul(monkeypatch)
+    fallback and n-argument pad/truncate — BOTH GEMM engines: the
+    planar engine's norm scaling and half-spectrum pad/truncate are
+    what FFT-less TPU runtimes actually run."""
+    _force_mode(monkeypatch, mode)
     rng = np.random.default_rng(11)
     for n in (10, 96, 101):
         x = rng.standard_normal((3, n))
@@ -280,3 +283,63 @@ def test_planar_mode_accepted(monkeypatch):
     # tolerance/flop accounting, identical between the two)
     assert _d.use_matmul_fft() is True
     _d.set_fft_mode(None)
+
+
+@pytest.mark.parametrize("n", [16, 15])
+@pytest.mark.parametrize("mode", ENGINES)
+def test_irfft_dc_nyquist_imag_leak(mode, monkeypatch, n):
+    """numpy semantics: irfft treats the DC (and, for even n, Nyquist)
+    bins as real — nonzero imaginary parts there must NOT leak into the
+    output. Both GEMM engines, even (packed untangle) and odd
+    (Hermitian-rebuild fallback) lengths."""
+    _force_mode(monkeypatch, mode)
+    rng = np.random.default_rng(31)
+    nh = n // 2 + 1
+    X = (rng.standard_normal((3, nh))
+         + 1j * rng.standard_normal((3, nh)))  # imag at bins 0 and -1
+    for norm in (None, "ortho", "forward"):
+        got = np.asarray(dft.irfft(jnp.asarray(X), n=n, norm=norm))
+        assert _rel(got, np.fft.irfft(X, n=n, norm=norm)) < 1e-10, \
+            (n, norm)
+
+
+@pytest.mark.parametrize("mode", ENGINES)
+def test_irfft_pad_truncate_all_norms(mode, monkeypatch):
+    """Half-spectrum pad/truncate (n argument) through both GEMM
+    engines across every norm."""
+    _force_mode(monkeypatch, mode)
+    rng = np.random.default_rng(32)
+    X = (rng.standard_normal((2, 13))
+         + 1j * rng.standard_normal((2, 13)))
+    for n in (16, 32, 20, 11):
+        for norm in (None, "ortho", "forward"):
+            got = np.asarray(dft.irfft(jnp.asarray(X), n=n, norm=norm))
+            assert _rel(got, np.fft.irfft(X, n=n, norm=norm)) < 1e-10, \
+                (n, norm)
+
+
+def test_planes_int_input_promotes_to_f64(monkeypatch):
+    """Integer inputs promote through the COMPLEX result type (x64
+    jnp.fft semantics: int64 -> complex128), so the planar engine must
+    put them on float64 planes — not the float32 the raw storage dtype
+    maps to."""
+    from pylops_mpi_tpu.utils import deps
+    import jax
+    if not jax.config.jax_enable_x64:
+        pytest.skip("x64 disabled in this session")
+    _force_mode(monkeypatch, "planar")
+    x = np.arange(24, dtype=np.int64).reshape(2, 12)
+    hr, hi = dft.rfft_planes(jnp.asarray(x))
+    assert hr.dtype == np.float64 and hi.dtype == np.float64
+    assert _rel(np.asarray(hr) + 1j * np.asarray(hi),
+                np.fft.rfft(x)) < 1e-12
+    back = dft.irfft_planes(hr, hi, n=12)
+    assert back.dtype == np.float64
+    assert _rel(np.asarray(back), x) < 1e-12
+    # the complex-signature wrapper agrees end to end
+    assert np.asarray(dft.rfft(jnp.asarray(x))).dtype == np.complex128
+    # plane_dtype is the public statement of the rule
+    assert dft.plane_dtype(np.int64) == "float64"
+    assert dft.plane_dtype(np.float32) == "float32"
+    assert dft.plane_dtype(np.complex128) == "float64"
+    assert dft.plane_dtype(np.float16) == "float32"

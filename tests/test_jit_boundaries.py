@@ -195,7 +195,11 @@ def test_fused_cgls_collective_schedule_is_scalar_only(rng):
         # (gather, permute, reduce-scatter, ...) is a layout regression
         assert set(rep) <= {"all-reduce"}, rep
         ar = rep.get("all-reduce", {"count": 0, "max_bytes": 0})
-        assert ar["count"] == 3, rep          # the psum'd solver scalars
+        # the psum'd solver scalars: 3 on current jax; the 0.4.x
+        # compiler CSEs one fewer and emits 4 — both are the same
+        # scalar-only schedule (the regression this pins is a DATA-sized
+        # collective appearing, caught by max_bytes and the kind check)
+        assert 3 <= ar["count"] <= 4, rep
         assert ar["max_bytes"] <= 16, rep     # each is one scalar
 
 
