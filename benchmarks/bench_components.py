@@ -569,8 +569,8 @@ def _bench_cgls_multirhs(pmt, rng, n_dev, scale):
         x0 = pmt.DistributedArray(global_shape=Op.shape[1],
                                   local_shapes=Op.local_shapes_m,
                                   dtype=np.float32)
-        fn = jax.jit(lambda yy, xx: _cgls_fused(Op, yy, xx, niter,
-                                                0.0, 0.0)[0]._arr)
+        fn = jax.jit(lambda yy, xx: _cgls_fused(Op, yy, xx, 0.0, 0.0,
+                                                niter=niter)[0]._arr)
         dt = _timeit(fn, y, x0, reps=3, inner=1)
         return niter * k / dt
 

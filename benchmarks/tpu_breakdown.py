@@ -114,7 +114,7 @@ def main() -> None:
     points = []
     for nit in niters:
         fn = jax.jit(lambda y, x, damp, tol, _n=nit:
-                     _cgls_fused(Op, y, x, _n, damp, tol))
+                     _cgls_fused(Op, y, x, damp, tol, niter=_n))
         t = best(lambda: jax.block_until_ready(fn(dy, x0, 0.0, 0.0)[0]._arr))
         points.append({"niter": nit, "ms": round(t * 1e3, 3)})
         out["niter_points_partial"] = points
@@ -172,7 +172,7 @@ def main() -> None:
     # 4. XLA's own estimate for the 60-iter solve
     try:
         lowered = jax.jit(
-            lambda y, x: _cgls_fused(Op, y, x, niters[-1], 0.0, 0.0)
+            lambda y, x: _cgls_fused(Op, y, x, 0.0, 0.0, niter=niters[-1])
         ).lower(dy, x0)
         ca = lowered.compile().cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -203,8 +203,8 @@ def main() -> None:
             _HERE, ".profile_r04",
             time.strftime("%Y%m%dT%H%M%S") + f"-{os.getpid()}")
         try:
-            fn20 = jax.jit(lambda y, x: _cgls_fused(Op, y, x, 20,
-                                                    0.0, 0.0)[0]._arr)
+            fn20 = jax.jit(lambda y, x: _cgls_fused(Op, y, x, 0.0, 0.0,
+                                                    niter=20)[0]._arr)
             jax.block_until_ready(fn20(dy, x0))  # compile outside trace
             with jax.profiler.trace(trace_dir):
                 jax.block_until_ready(fn20(dy, x0))

@@ -175,7 +175,7 @@ def main():
                           pmt.DistributedArray.to_dist(y, mesh=mesh),
                           pmt.DistributedArray.to_dist(
                               np.zeros_like(xt), mesh=mesh),
-                          30, 0.0, 0.0)
+                          0.0, 0.0, niter=30)
         got = np.asarray(out[0].asarray())
         return float(np.linalg.norm(got - xt) / np.linalg.norm(xt))
     step("cgls_fused_nojit", _cgls_nojit)
@@ -183,8 +183,8 @@ def main():
     def _cgls_jit():
         import jax as _jax
         Op, y, xt = _mk(1, 256)
-        out = _jax.jit(lambda yy, xx: _cgls_fused(Op, yy, xx, 30, 0.0,
-                                                  0.0))(
+        out = _jax.jit(lambda yy, xx: _cgls_fused(Op, yy, xx, 0.0, 0.0,
+                                                  niter=30))(
             pmt.DistributedArray.to_dist(y, mesh=mesh),
             pmt.DistributedArray.to_dist(np.zeros_like(xt), mesh=mesh))
         got = np.asarray(out[0].asarray())

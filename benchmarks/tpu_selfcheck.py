@@ -195,7 +195,7 @@ def run_selfcheck() -> dict:
         Op = pmt.MPIBlockDiag([MatrixMult(b, dtype=np.float32)
                                for b in blocks])
         out = jax.jit(lambda yy, xx: _cgls_fused(
-            Op, yy, xx, 30, 0.0, 0.0))(
+            Op, yy, xx, 0.0, 0.0, niter=30))(
             pmt.DistributedArray.to_dist(y, mesh=mesh),
             pmt.DistributedArray.to_dist(np.zeros_like(xt), mesh=mesh))
         return _rel_err(out[0].asarray(), xt)

@@ -506,6 +506,11 @@ class DistributedArray:
         own group's value; here all groups are visible at once)."""
         a = jnp.conj(self._arr) if vdot else self._arr
         z = a * self._operand_phys(y)
+        # narrow (bf16/f16) vector spaces accumulate at f32 — the
+        # precision policy's reduction floor (ops/_precision.py); a
+        # no-op cast for f32 and wider
+        from .ops._precision import accum_dtype
+        z = z.astype(accum_dtype(z.dtype))
         if self._partition != Partition.SCATTER:
             # BROADCAST ignores mask, as the reference's to_dist round-trip
             # in dot does (ref DistributedArray.py:678-682)
@@ -519,6 +524,14 @@ class DistributedArray:
         if ord in ("fro", "nuc"):
             raise ValueError(f"norm-{ord} not possible for vectors")
         x = self._arr
+        # narrow (bf16/f16) spaces reduce at f32 — the precision
+        # policy's reduction floor (ops/_precision.py); complex dtypes
+        # are never sub-f32
+        if not jnp.issubdtype(x.dtype, jnp.complexfloating):
+            from .ops._precision import accum_dtype
+            acc = accum_dtype(x.dtype)
+            if acc != np.dtype(x.dtype):
+                x = x.astype(acc)
         if self._partition != Partition.SCATTER:
             x2 = jnp.abs(x)
             if ord == 0:

@@ -1,19 +1,159 @@
-"""The one implementation of the narrow-storage contraction rule.
+"""Mixed-precision policy: storage vs compute vs reduction dtypes.
 
-``compute_dtype`` operators (bf16 / complex64 tiles) must contract
-with BOTH operands narrow and accumulate in the operator dtype via
-``preferred_element_type`` — einsum's type promotion would otherwise
-read the narrow buffer back at the wide dtype (potentially
-materializing a full-size wide temporary), defeating the HBM-bandwidth
-lever. Shared by MPIBlockDiag, MPIVStack/MPIHStack and MPIFredholm1.
+One place answers three questions the HBM-bound solver stack keeps
+asking (ISSUE 2 tentpole; the scheme of "Large Scale Distributed
+Linear Algebra With Tensor Processing Units", arXiv:2112.09017 —
+narrow *storage*, full-precision *accumulation*):
+
+- **storage dtype** — what the operator's matrix tiles live at in HBM.
+  Narrow storage (bf16 for f32 operators, c64 for c128) halves the
+  bytes every matvec streams; it is the only lever that moves the
+  HBM roofline.
+- **compute dtype** — what the contraction's *matrix* operand enters
+  the GEMM at. The matrix stays narrow (that is the point); the
+  **vector operand is NEVER narrowed**: rounding the solver's model /
+  residual vectors to bf16 each iteration injects ~2⁻⁹ relative noise
+  into the Krylov recurrence and caps the attainable solve accuracy
+  at ~1e-3 regardless of how the scalars are accumulated (round-5
+  ``bf16_race`` anomaly, attributed by the dtype-stability tests).
+- **reduction dtype** — what dot products / norms / recurrence
+  scalars accumulate at. Never below float32 (``preferred_element_type``
+  on every narrow contraction; f32 ``psum``s for bf16 vectors).
+
+The policy is resolved once from ``PYLOPS_MPI_TPU_PRECISION``
+(``f32``/unset → no narrowing, ``bf16`` → bf16 storage for real f32
+operators, ``c64`` → complex64 storage for complex128 operators) and
+cached; :func:`set_precision` overrides programmatically (tests, CI
+legs). Operators consume it through :func:`default_compute_dtype` when
+the user passes ``compute_dtype=None``; an explicit ``compute_dtype``
+always wins.
+
+Buffer donation for the fused solvers is gated here too
+(``PYLOPS_MPI_TPU_DONATE``, default on): the fused ``while_loop``
+entries donate the model-vector argument so the loop carry aliases the
+input buffer in place instead of copying it at program entry
+(``utils/hlo.assert_donation`` pins this in CI).
 """
 
 from __future__ import annotations
 
+import os
+from typing import NamedTuple, Optional
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 
-__all__ = ["einsum_narrow", "check_compute_dtype"]
+__all__ = ["PrecisionPolicy", "get_policy", "set_precision",
+           "default_compute_dtype", "reduction_dtype", "accum_dtype",
+           "donation_enabled", "einsum_narrow", "check_compute_dtype"]
+
+
+class PrecisionPolicy(NamedTuple):
+    """Resolved precision policy (see module docstring)."""
+    name: str                 # "f32" | "bf16" | "c64"
+    storage_real: Optional[np.dtype]     # narrow storage for f32 operators
+    storage_complex: Optional[np.dtype]  # narrow storage for c128 operators
+    reduction_min: np.dtype   # floor for dot/norm/recurrence accumulation
+
+
+_POLICIES = {
+    "f32": PrecisionPolicy("f32", None, None, np.dtype(np.float32)),
+    "bf16": PrecisionPolicy("bf16", np.dtype(jnp.bfloat16), None,
+                            np.dtype(np.float32)),
+    "c64": PrecisionPolicy("c64", None, np.dtype(np.complex64),
+                           np.dtype(np.float32)),
+}
+
+_policy_cache: Optional[PrecisionPolicy] = None
+
+
+def get_policy() -> PrecisionPolicy:
+    """The active policy: cached first resolution of
+    ``PYLOPS_MPI_TPU_PRECISION`` (unknown values fall back to ``f32``
+    with a one-time warning — a typo in a CI matrix must not silently
+    change numerics in either direction)."""
+    global _policy_cache
+    if _policy_cache is None:
+        name = os.environ.get("PYLOPS_MPI_TPU_PRECISION", "f32").lower()
+        if name in ("", "none", "default"):
+            name = "f32"
+        if name not in _POLICIES:
+            import warnings
+            warnings.warn(
+                f"PYLOPS_MPI_TPU_PRECISION={name!r} is not one of "
+                f"{sorted(_POLICIES)}; using 'f32' (no narrowing)",
+                stacklevel=2)
+            name = "f32"
+        _policy_cache = _POLICIES[name]
+    return _policy_cache
+
+
+def set_precision(name: Optional[str]) -> PrecisionPolicy:
+    """Programmatic override of the env seam (``None`` re-resolves the
+    env on next use). Does NOT clear jit caches: operators capture
+    their storage dtype at construction, so existing instances keep the
+    precision they were built with — build new operators after
+    switching."""
+    global _policy_cache
+    if name is None:
+        _policy_cache = None
+        return get_policy()
+    if name not in _POLICIES:
+        raise ValueError(f"unknown precision policy {name!r}; "
+                         f"expected one of {sorted(_POLICIES)}")
+    _policy_cache = _POLICIES[name]
+    return _policy_cache
+
+
+def default_compute_dtype(op_dtype) -> Optional[np.dtype]:
+    """Storage/compute dtype an operator of ``op_dtype`` should use
+    when the user passed ``compute_dtype=None``. Only exact matches
+    narrow — f32 under the bf16 policy, c128 under c64; f64 is never
+    narrowed (it is the oracle precision the test suite compares
+    against) and already-narrow dtypes pass through untouched."""
+    pol = get_policy()
+    dt = np.dtype(op_dtype)
+    if pol.storage_real is not None and dt == np.dtype(np.float32):
+        return pol.storage_real
+    if pol.storage_complex is not None and dt == np.dtype(np.complex128):
+        return pol.storage_complex
+    return None
+
+
+def reduction_dtype(carry_dtype) -> np.dtype:
+    """Accumulation dtype for dot products / norms / recurrence scalars
+    over vectors of ``carry_dtype``: the carry's real counterpart,
+    floored at the policy's ``reduction_min`` (f32) — a bf16 vector
+    space still reduces in f32."""
+    dt = np.dtype(carry_dtype)
+    floor = get_policy().reduction_min
+    if jnp.issubdtype(dt, jnp.complexfloating):
+        real = np.finfo(dt).dtype  # c64 -> f32, c128 -> f64
+        return real if real.itemsize >= floor.itemsize else floor
+    # jnp.issubdtype: np's misses extended dtypes (bfloat16)
+    if jnp.issubdtype(dt, jnp.floating) and dt.itemsize >= floor.itemsize:
+        return dt
+    return floor
+
+
+def accum_dtype(dtype) -> np.dtype:
+    """Accumulation dtype for elementwise-product/abs reductions that
+    must keep the operand's complexity: sub-f32 floats (bf16/f16)
+    accumulate at f32, everything at f32 or wider is unchanged. Used by
+    ``DistributedArray.dot``/``norm`` so a narrow vector space never
+    sums at a narrow dtype."""
+    dt = np.dtype(dtype)
+    # jnp.issubdtype: np's misses extended dtypes (bfloat16)
+    if jnp.issubdtype(dt, jnp.floating) and dt.itemsize < 4:
+        return np.dtype(np.float32)
+    return dt
+
+
+def donation_enabled() -> bool:
+    """Whether fused solver entries donate their model-vector argument
+    (``PYLOPS_MPI_TPU_DONATE``, default on)."""
+    return os.environ.get("PYLOPS_MPI_TPU_DONATE", "1") != "0"
 
 
 def check_compute_dtype(compute_dtype, op_dtype, where: str) -> None:
@@ -33,11 +173,17 @@ def check_compute_dtype(compute_dtype, op_dtype, where: str) -> None:
 
 
 def einsum_narrow(spec: str, A, v, compute_dtype, out_dtype):
-    """``jnp.einsum(spec, A, v)`` honoring the narrow-storage rule.
+    """``jnp.einsum(spec, A, v)`` honoring the narrow-storage rule:
     ``A`` is already stored at ``compute_dtype`` (or the operator dtype
-    when ``compute_dtype`` is None); ``v`` is narrowed to match and the
-    contraction accumulates in ``out_dtype``."""
+    when ``compute_dtype`` is None) and enters the contraction NARROW —
+    its HBM read is the narrow bytes; the on-the-fly widen fuses into
+    the GEMM's operand read (pinned ≤2 A-tile converts/iteration by
+    ``tests/test_precision.py``). ``v`` stays at ITS OWN dtype — see
+    the module docstring: narrowing the vector operand per iteration
+    is the recurrence contamination behind the round-5 bf16 cliff. The
+    contraction accumulates in ``out_dtype`` via
+    ``preferred_element_type``."""
     if compute_dtype is None:
         return jnp.einsum(spec, A, v)
-    return jnp.einsum(spec, A, v.astype(compute_dtype),
+    return jnp.einsum(spec, A, v,
                       preferred_element_type=np.dtype(out_dtype))

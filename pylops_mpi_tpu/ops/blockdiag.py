@@ -57,6 +57,12 @@ class MPIBlockDiag(MPILinearOperator):
     mask : list of int, optional
         Shard-group coloring; carried onto input/output arrays so their
         reductions group exactly as the reference's sub-communicators do.
+    compute_dtype : dtype, optional
+        Narrow storage for the batched block stack (e.g.
+        ``jnp.bfloat16``). When ``None``, the precision policy
+        (``PYLOPS_MPI_TPU_PRECISION``, ops/_precision.py) decides —
+        under the ``bf16`` policy f32 block stacks store narrow
+        automatically; pass an explicit dtype to override either way.
     """
 
     def __init__(self, ops: Sequence[LocalOperator],
@@ -81,6 +87,9 @@ class MPIBlockDiag(MPILinearOperator):
         shape = (int(nops.sum()), int(mops.sum()))
         dtype = dtype or np.result_type(*[op.dtype for op in self.ops])
         super().__init__(shape=shape, dtype=dtype)
+        if self.compute_dtype is None:  # env-policy default (f32 only)
+            from ._precision import default_compute_dtype
+            self.compute_dtype = default_compute_dtype(dtype)
         self._batched = self._try_batch()
 
     def _try_batch(self):

@@ -80,6 +80,11 @@ class MPIFredholm1(MPILinearOperator):
                     np.ones(1, dtype=compute_dtype)).dtype
             if np.issubdtype(np.dtype(dtype), np.complexfloating):
                 dtype = np.real(np.ones(1, dtype=np.dtype(dtype))).dtype
+        if compute_dtype is None:
+            # env-policy default: bf16 storage for f32 kernels under
+            # the bf16 policy, c64 for c128 under the c64 policy
+            from ._precision import default_compute_dtype
+            compute_dtype = default_compute_dtype(dtype)
         self.compute_dtype = compute_dtype
         self.nz = int(nz)
         if self.planar:

@@ -143,6 +143,9 @@ class _MatMulBase(MPILinearOperator):
             raise ValueError(
                 "compute_dtype is only supported for real float32 "
                 f"operators, dtype is {self.dtype}")
+        if compute_dtype is None:  # env-policy default (f32 only)
+            from ._precision import default_compute_dtype
+            compute_dtype = default_compute_dtype(self.dtype)
         self.compute_dtype = compute_dtype
         self.A = self._place_A(A)
         # adjoint reuses conj(A) tiles on the fly unless saveAt
@@ -158,13 +161,15 @@ class _MatMulBase(MPILinearOperator):
                 else At
 
     def _gemm(self, a, b):
-        """Local GEMM honouring compute_dtype: cast operands down,
-        accumulate in f32 on the MXU, return at the operator dtype."""
+        """Local GEMM honouring compute_dtype: the matrix operand ``a``
+        is already STORED narrow (``_place_A``) and enters the GEMM
+        narrow — that is the HBM/wire lever; the vector/tile operand
+        ``b`` stays at its own dtype (never round the solver's vectors
+        per iteration — ops/_precision.py module doc) and the product
+        accumulates in f32."""
         if self.compute_dtype is None:
             return a @ b
-        out = jnp.matmul(a.astype(self.compute_dtype),
-                         b.astype(self.compute_dtype),
-                         preferred_element_type=jnp.float32)
+        out = jnp.matmul(a, b, preferred_element_type=jnp.float32)
         return out.astype(self.dtype)
 
     def _place_A(self, A):
@@ -258,9 +263,11 @@ class _MPISummaMatrixMult(_MatMulBase):
         # full copy of A at compile time (very slow for large A). Stored
         # at compute_dtype when set — bf16 tiles also halve the
         # all-gather bytes on the wire, not just HBM reads.
+        # self.compute_dtype, not the ctor arg: the env policy may have
+        # filled it in during super().__init__
         Ap = _pad_to(jnp.asarray(self.A), self.Np, self.Kp_c)
-        if compute_dtype is not None:
-            Ap = Ap.astype(compute_dtype)
+        if self.compute_dtype is not None:
+            Ap = Ap.astype(self.compute_dtype)
         self.Ap = jax.device_put(
             Ap, NamedSharding(self.mesh2, P("r", "c")))
 
@@ -270,8 +277,10 @@ class _MPISummaMatrixMult(_MatMulBase):
     def _kernel_fwd(self, Ablk, Xblk):
         # Ablk: (Np/pr, Kp_c/pc) tile; Xblk: (Kp_r... ) — gather full
         # row of A along 'c' and full column of X along 'r', one GEMM.
-        if self.compute_dtype is not None:      # gather at the narrow
-            Xblk = Xblk.astype(self.compute_dtype)  # dtype: fewer bytes
+        # Under compute_dtype the A tiles are narrow on the wire AND in
+        # HBM; X gathers at its own (wide) dtype — rounding the model
+        # vector per apply is the recurrence contamination the
+        # precision policy forbids (ops/_precision.py).
         Arow = lax.all_gather(Ablk, "c", axis=1, tiled=True)   # (Np/pr, Kp_c)
         Xcol = lax.all_gather(Xblk, "r", axis=0, tiled=True)   # (Kp_r, Mp/pc)
         return self._gemm(Arow[:, :self.K], Xcol[:self.K])
@@ -281,8 +290,7 @@ class _MPISummaMatrixMult(_MatMulBase):
         # tile against its k-block, reduce-scatter partials along 'c'.
         # Zero bytes of A on the wire; padding is benign because X's
         # pad rows are zeros (they meet A's pad columns in the GEMM).
-        if self.compute_dtype is not None:
-            Xblk = Xblk.astype(self.compute_dtype)
+        # X gathers wide (see _kernel_fwd note).
         Xfull = lax.all_gather(Xblk, "r", axis=0, tiled=True)   # (Kp_r, Mp/pc)
         Xfull = lax.all_gather(Xfull, "c", axis=1, tiled=True)  # (Kp_r, Mp)
         if self.Kp_c > self.Kp_r:
@@ -299,9 +307,8 @@ class _MPISummaMatrixMult(_MatMulBase):
         # tiles along 'c' (full M for this row-block), one local GEMM
         # against the owned A tile, then psum the partial K-block over
         # 'r'. The reference's tagged-p2p Aᴴ pipeline (ref
-        # MatrixMult.py:744-761) becomes gather + reduce.
-        if self.compute_dtype is not None:
-            Yblk = Yblk.astype(self.compute_dtype)
+        # MatrixMult.py:744-761) becomes gather + reduce; Y gathers
+        # wide (see _kernel_fwd note).
         Yrow = lax.all_gather(Yblk, "c", axis=1, tiled=True)   # (Np/pr, Mp)
         part = self._gemm(jnp.conj(Ablk).T, Yrow)              # (Kp_c/pc, Mp)
         return lax.psum(part, "r")

@@ -156,23 +156,57 @@ def stencil_taps(slab: jax.Array, taps, w: int,
 # VMEM, so q = A x and u = AᵀA x cost a single read of A. This is the
 # solver hot-spot of SURVEY §3.2 (the reference reads its matrix once in
 # matvec and once in rmatvec per iteration, ref cls_basic.py:389-397).
+#
+# Two kernels share the schedule:
+#
+# - ``_normal_kernel`` (f32 blocks): tile loaded at its own dtype,
+#   dots accumulate f32.
+# - ``_normal_kernel_stream`` (bf16/f16 blocks — the HBM-regime fast
+#   path, ISSUE 2): the A tile streams HBM→VMEM at the NARROW dtype
+#   (half the bytes of f32 — the only term that matters at 64 MB/block
+#   working sets) and is widened to f32 once in VMEM; both dots and
+#   the u accumulator run f32, and the (f32) x vector is never
+#   narrowed — bf16 touches storage and the wire, never the solver
+#   recurrence (ops/_precision.py module doc).
 
 _VMEM_TILE_BYTES = 4 << 20  # A-tile budget (double-buffered by pipeline)
 
 
-def _pick_tile(m: int, n: int, itemsize: int):
-    """Row-tile honouring both the VMEM budget and Mosaic's sublane
-    rule: every blocked dim must be 8-divisible (sublanes) or equal to
-    the full array dim — the round-3 hardware selfcheck showed tiles of
-    1/2/4 rows that pass in interpret mode are rejected by the TPU
-    lowering. ``None`` when no legal tile fits (caller falls back to the
-    generic two-sweep path)."""
+def _min_sublane(dtype) -> int:
+    """Mosaic's minimum sublane multiple per dtype: 8 for 4-byte
+    elements, 16 for 2-byte (bf16/f16), 32 for 1-byte — a narrow
+    block's second-to-minor blocked dim must honor the packed tile."""
+    return max(8, 32 // max(np.dtype(dtype).itemsize, 1))
+
+
+def _pick_tile(m: int, n: int, itemsize: int, min_sublane: int = 8):
+    """Row-tile honouring the VMEM budget and Mosaic's sublane rule:
+    every blocked dim must be a multiple of the dtype's sublane tile
+    (8 for f32, 16 for bf16) or equal to the full array dim — the
+    round-3 hardware selfcheck showed tiles of 1/2/4 rows that pass in
+    interpret mode are rejected by the TPU lowering. ``None`` when no
+    legal tile fits (caller falls back to the generic two-sweep
+    path)."""
     for tm in (512, 256, 128, 64, 32, 16, 8):
+        if tm < min_sublane:
+            break
         if m % tm == 0 and tm * n * itemsize <= _VMEM_TILE_BYTES:
             return tm
     if m * n * itemsize <= _VMEM_TILE_BYTES:
         return m  # whole-dim block: always legal
     return None
+
+
+def _tile_args(A: jax.Array):
+    """(row-tile, streaming?) for ``A``'s blocks. Narrow (sub-4-byte)
+    blocks take the streaming kernel: the VMEM budget is charged for
+    the f32 widened copy (worst term), the sublane rule for the narrow
+    loaded block."""
+    m, n = A.shape[1], A.shape[2]
+    stream = A.dtype.itemsize < 4
+    tm = _pick_tile(m, n, max(A.dtype.itemsize, 4),
+                    min_sublane=_min_sublane(A.dtype))
+    return tm, stream
 
 
 def normal_matvec_supported(A: jax.Array) -> bool:
@@ -182,8 +216,7 @@ def normal_matvec_supported(A: jax.Array) -> bool:
     if not (_HAS_PALLAS and pallas_available() and A.ndim == 3
             and not jnp.iscomplexobj(A)):
         return False
-    m, n = A.shape[1], A.shape[2]
-    return _pick_tile(m, n, max(A.dtype.itemsize, 4)) is not None
+    return _tile_args(A)[0] is not None
 
 
 def _normal_kernel(a_ref, x_ref, u_ref, q_ref):
@@ -204,24 +237,50 @@ def _normal_kernel(a_ref, x_ref, u_ref, q_ref):
     u_ref[...] += u[None].astype(u_ref.dtype)
 
 
+def _normal_kernel_stream(a_ref, x_ref, u_ref, q_ref):
+    """bf16-tile-streaming variant: ``a_ref`` is the NARROW block (its
+    HBM→VMEM copy moved the narrow bytes — the streaming win); the one
+    widen to f32 happens here in VMEM, and everything downstream
+    (both dots, the running u accumulator, the q/u outputs) is f32.
+    The x vector arrives f32 and stays f32 — no per-iteration rounding
+    of solver state."""
+    i = pl.program_id(1)
+    a = a_ref[0].astype(jnp.float32)                # one VMEM widen/tile
+    x = x_ref[0].astype(jnp.float32)                # (1, n), f32 already
+    t = jax.lax.dot_general(a, x, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    q_ref[...] = t[None].astype(q_ref.dtype)
+    u = jax.lax.dot_general(t, a, (((0,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(i == 0)
+    def _():
+        u_ref[...] = jnp.zeros_like(u_ref)
+
+    u_ref[...] += u[None].astype(u_ref.dtype)
+
+
 def batched_normal_matvec(A: jax.Array, X: jax.Array):
     """``(u, q) = (AᵀA x, A x)`` per block, reading each ``A`` block once.
 
-    A: ``(nblk, m, n)`` real; X: ``(nblk, n)``. Returns
-    ``u (nblk, n)``, ``q (nblk, m)``. Call per shard (inside shard_map);
-    on CPU runs in interpret mode. The x/u/q operands are staged as
-    trivially-blocked 3-D views — a 2-D ``(1, n)`` block over an
-    ``(nblk, n)`` array has a sublane dim of 1 that is neither
-    8-divisible nor equal to ``nblk``, which Mosaic rejects.
+    A: ``(nblk, m, n)`` real (f32, or bf16/f16 storage — the narrow
+    case streams through ``_normal_kernel_stream``); X: ``(nblk, n)``,
+    kept at ITS dtype (f32 for the mixed-precision solver stack).
+    Returns ``u (nblk, n)``, ``q (nblk, m)`` at X's dtype. Call per
+    shard (inside shard_map); on CPU runs in interpret mode. The x/u/q
+    operands are staged as trivially-blocked 3-D views — a 2-D
+    ``(1, n)`` block over an ``(nblk, n)`` array has a sublane dim of 1
+    that is neither 8-divisible nor equal to ``nblk``, which Mosaic
+    rejects.
     """
     nblk, m, n = A.shape
-    tm = _pick_tile(m, n, max(A.dtype.itemsize, 4))  # bound the f32 copy
+    tm, stream = _tile_args(A)
     if tm is None:
         raise ValueError(f"no Mosaic-legal row tile for blocks of {m}x{n}; "
                          "gate on normal_matvec_supported()")
     out_dtype = X.dtype
     u, q = pl.pallas_call(
-        _normal_kernel,
+        _normal_kernel_stream if stream else _normal_kernel,
         grid=(nblk, m // tm),
         in_specs=[pl.BlockSpec((1, tm, n), lambda b, i: (b, i, 0)),
                   pl.BlockSpec((1, 1, n), lambda b, i: (b, 0, 0))],

@@ -60,6 +60,9 @@ class MPIVStack(MPILinearOperator):
         shape = (int(self.nops.sum()), int(cols.pop()))
         dtype = dtype or np.result_type(*[op.dtype for op in self.ops])
         super().__init__(shape=shape, dtype=dtype)
+        if self.compute_dtype is None:  # env-policy default (f32 only)
+            from ._precision import default_compute_dtype
+            self.compute_dtype = default_compute_dtype(dtype)
         self._batched, self._batched_adj = self._try_batch()
 
     def _try_batch(self):

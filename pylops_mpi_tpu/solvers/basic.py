@@ -45,6 +45,37 @@ def _abs(v):
     return jnp.abs(jnp.asarray(v))
 
 
+def _vdtype(v):
+    """Element dtype of a (possibly nested-stacked) distributed
+    vector."""
+    if isinstance(v, StackedDistributedArray):
+        return np.result_type(*[_vdtype(d) for d in v.distarrays])
+    return v.dtype
+
+
+def _rdot(u, v):
+    """Recurrence dot product at the policy reduction dtype: the
+    squared-norm scalars (``k``, ``cOpc``, ``q·q``) must accumulate at
+    f32 or better even when the carry vectors are narrower — a bf16
+    ``k/kold`` ratio is the recurrence contamination behind the round-5
+    bf16 cliff (ops/_precision.py module doc). For ≥f32 carries this is
+    exactly the old ``_abs(u.dot(v.conj()))``."""
+    from ..ops._precision import reduction_dtype
+    return _abs(u.dot(v.conj())).astype(reduction_dtype(_vdtype(u)))
+
+
+def _step_scalar(s, carry_dtype):
+    """Cast a recurrence scalar for a vector update so the CARRY dtype
+    survives the multiply: a wide (f32) step scalar times a narrow
+    (bf16) carry would promote the carry and break the while_loop's
+    fixed pytree dtypes. Real scalars against complex carries pass
+    through (no promotion)."""
+    dt = np.dtype(carry_dtype)
+    if np.issubdtype(dt, np.complexfloating):
+        return s
+    return s.astype(dt)
+
+
 def _mp_floor(k0):
     """Machine-precision floor for the solver's squared recurrence
     norm — ``k = |r|²`` for CG, ``k = |Aᴴr|²`` for CGLS: once ``k``
@@ -240,22 +271,38 @@ class CGLS(_BaseSolver):
 
 
 # --------------------------------------------------------- fused (on-device)
-def _cg_fused(Op, y: Vector, x0: Vector, niter: int, tol):
+# Builder calling convention (shared by _get_fused and every fused
+# loop below): all runtime operands are POSITIONAL with the model
+# vector second — ``fn(y, x0, ...)`` — so donation can address it by
+# argnum. ``x0`` is donated (``_DONATE_X0``): the loop carry starts in
+# the caller's buffer instead of a program-entry copy, which is why
+# the builders bind the carry as ``x = x0`` (a traced ``x0.copy()``
+# would be exactly the copy-of-donated-state the HLO pin forbids —
+# tests/test_precision.py::test_fused_cgls_donation).
+_DONATE_X0 = (1,)
+
+
+def _cg_fused(Op, y: Vector, x0: Vector, tol, *, niter: int):
     """Whole CG solve as one ``lax.while_loop`` (SURVEY §3.2: the
     reference's hot loop does 4 host-synced allreduces per iteration —
-    here everything fuses into a single XLA program)."""
+    here everything fuses into a single XLA program). Recurrence
+    scalars accumulate at the policy reduction dtype (``_rdot``) and
+    re-enter vector updates at the carry dtype (``_step_scalar``) so
+    the carry pytree dtypes are identical at iteration 1 and k."""
+    xdt = _vdtype(x0)
 
     def body(state):
         x, r, c, kold, iiter, cost = state
         done = kold <= floors
         Opc = Op.matvec(c)
-        a = kold / _abs(c.dot(Opc.conj()))
+        a = kold / _rdot(c, Opc)
         a = jnp.where(done, jnp.zeros_like(a), a)
-        x = x + c * a
-        r = r - Opc * a
-        k = _abs(r.dot(r.conj()))
+        x = x + c * _step_scalar(a, xdt)
+        r = r - Opc * _step_scalar(a, xdt)
+        k = _rdot(r, r)
         k = jnp.where(done, kold, k)
-        c = r + c * jnp.where(done, jnp.zeros_like(k), k / kold)
+        b = jnp.where(done, jnp.zeros_like(k), k / kold)
+        c = r + c * _step_scalar(b, xdt)
         iiter = iiter + 1
         cost = lax.dynamic_update_index_in_dim(cost, jnp.sqrt(k), iiter, 0)
         return (x, r, c, k, iiter, cost)
@@ -264,10 +311,10 @@ def _cg_fused(Op, y: Vector, x0: Vector, niter: int, tol):
         _, _, _, kold, iiter, _ = state
         return (iiter < niter) & (jnp.max(kold) > tol)
 
-    x = x0.copy()
+    x = x0  # donated: the carry aliases the caller's buffer in place
     r = y - Op.matvec(x)
-    c = r.copy()
-    kold = _abs(r.dot(r.conj()))
+    c = r
+    kold = _rdot(r, r)
     floors = _mp_floor(kold)
     cost0 = jnp.zeros((niter + 1,) + jnp.shape(kold), dtype=jnp.asarray(kold).dtype)
     cost0 = lax.dynamic_update_index_in_dim(cost0, jnp.sqrt(kold), 0, 0)
@@ -276,50 +323,52 @@ def _cg_fused(Op, y: Vector, x0: Vector, niter: int, tol):
     return x, iiter, cost
 
 
-def _cgls_fused(Op, y: Vector, x0: Vector, niter: int, damp, tol):
+def _cgls_fused(Op, y: Vector, x0: Vector, damp, tol, *, niter: int):
     damp2 = damp ** 2
+    xdt = _vdtype(x0)
 
     def body(state):
         x, s, c, q, kold, iiter, cost, cost1 = state
         done = kold <= floors
-        a = _abs(kold / (q.dot(q.conj()) + damp2 * c.dot(c.conj())))
+        a = _abs(kold / (_rdot(q, q) + damp2 * _rdot(c, c)))
         a = jnp.where(done, jnp.zeros_like(a), a)
-        x = x + c * a
-        s = s - q * a
+        x = x + c * _step_scalar(a, xdt)
+        s = s - q * _step_scalar(a, xdt)
         r = Op.rmatvec(s) - x * damp2
-        k = _abs(r.dot(r.conj()))
+        k = _rdot(r, r)
         k = jnp.where(done, kold, k)
-        c = r + c * jnp.where(done, jnp.zeros_like(k), k / kold)
+        b = jnp.where(done, jnp.zeros_like(k), k / kold)
+        c = r + c * _step_scalar(b, xdt)
         q = Op.matvec(c)
         iiter = iiter + 1
         sn = jnp.asarray(s.norm())
         cost = lax.dynamic_update_index_in_dim(cost, sn, iiter, 0)
-        r2 = jnp.sqrt(sn ** 2 + damp2 * _abs(x.dot(x.conj())))
+        r2 = jnp.sqrt(sn ** 2 + damp2 * _rdot(x, x))
         cost1 = lax.dynamic_update_index_in_dim(cost1, r2, iiter, 0)
         return (x, s, c, q, k, iiter, cost, cost1)
 
     def cond(state):
         return (state[5] < niter) & (jnp.max(state[4]) > tol)
 
-    x = x0.copy()
+    x = x0  # donated: carry aliases the caller's buffer (see _DONATE_X0)
     s = y - Op.matvec(x)
     r = Op.rmatvec(s) - x * damp  # ref's un-squared setup damp
-    c = r.copy()
+    c = r
     q = Op.matvec(c)
-    kold = _abs(r.dot(r.conj()))
+    kold = _rdot(r, r)
     floors = _mp_floor(kold)
     sn0 = jnp.asarray(s.norm())
     cost0 = jnp.zeros((niter + 1,) + jnp.shape(sn0), dtype=sn0.dtype)
     cost0 = lax.dynamic_update_index_in_dim(cost0, sn0, 0, 0)
     cost1_0 = lax.dynamic_update_index_in_dim(
         jnp.zeros_like(cost0),
-        jnp.sqrt(sn0 ** 2 + damp2 * _abs(x.dot(x.conj()))), 0, 0)
+        jnp.sqrt(sn0 ** 2 + damp2 * _rdot(x, x)), 0, 0)
     state = (x, s, c, q, kold, jnp.asarray(0), cost0, cost1_0)
     x, s, c, q, kold, iiter, cost, cost1 = lax.while_loop(cond, body, state)
     return x, iiter, cost, cost1, kold
 
 
-def _cgls_fused_normal(Op, y: Vector, x0: Vector, niter: int, damp, tol):
+def _cgls_fused_normal(Op, y: Vector, x0: Vector, damp, tol, *, niter: int):
     """CGLS with one operator memory sweep per iteration: the step uses
     ``(u, q) = Op.normal_matvec(c)`` (``u = OpᴴOp c`` computed in the
     same pass that yields ``q = Op c``) and the gradient recurrence
@@ -328,34 +377,36 @@ def _cgls_fused_normal(Op, y: Vector, x0: Vector, niter: int, damp, tol):
     traffic on memory-bound matvecs; enabled when
     ``Op.has_fused_normal``."""
     damp2 = damp ** 2
+    xdt = _vdtype(x0)
 
     def body(state):
         x, s, r, c, kold, iiter, cost, cost1 = state
         done = kold <= floors
         u, q = Op.normal_matvec(c)
-        a = _abs(kold / (q.dot(q.conj()) + damp2 * c.dot(c.conj())))
+        a = _abs(kold / (_rdot(q, q) + damp2 * _rdot(c, c)))
         a = jnp.where(done, jnp.zeros_like(a), a)
-        x = x + c * a
-        s = s - q * a
-        r = r - (u + c * damp2) * a
-        k = _abs(r.dot(r.conj()))
+        x = x + c * _step_scalar(a, xdt)
+        s = s - q * _step_scalar(a, xdt)
+        r = r - (u + c * damp2) * _step_scalar(a, xdt)
+        k = _rdot(r, r)
         k = jnp.where(done, kold, k)
-        c = r + c * jnp.where(done, jnp.zeros_like(k), k / kold)
+        b = jnp.where(done, jnp.zeros_like(k), k / kold)
+        c = r + c * _step_scalar(b, xdt)
         iiter = iiter + 1
         sn = jnp.asarray(s.norm())
         cost = lax.dynamic_update_index_in_dim(cost, sn, iiter, 0)
-        r2 = jnp.sqrt(sn ** 2 + damp2 * _abs(x.dot(x.conj())))
+        r2 = jnp.sqrt(sn ** 2 + damp2 * _rdot(x, x))
         cost1 = lax.dynamic_update_index_in_dim(cost1, r2, iiter, 0)
         return (x, s, r, c, k, iiter, cost, cost1)
 
     def cond(state):
         return (state[5] < niter) & (jnp.max(state[4]) > tol)
 
-    x = x0.copy()
+    x = x0  # donated: carry aliases the caller's buffer (see _DONATE_X0)
     s = y - Op.matvec(x)
     rq = Op.rmatvec(s) - x * damp  # ref's un-squared setup damp (see
-    c = rq.copy()                  # module doc) seeds only the first
-    kold = _abs(rq.dot(rq.conj()))  # direction, as in the classic path
+    c = rq                         # module doc) seeds only the first
+    kold = _rdot(rq, rq)            # direction, as in the classic path
     floors = _mp_floor(kold)
     # the recurrence tracks the true gradient r = Opᴴs − damp²x, so it
     # must start from the damp²-form, not the quirked one
@@ -365,7 +416,7 @@ def _cgls_fused_normal(Op, y: Vector, x0: Vector, niter: int, damp, tol):
     cost0 = lax.dynamic_update_index_in_dim(cost0, sn0, 0, 0)
     cost1_0 = lax.dynamic_update_index_in_dim(
         jnp.zeros_like(cost0),
-        jnp.sqrt(sn0 ** 2 + damp2 * _abs(x.dot(x.conj()))), 0, 0)
+        jnp.sqrt(sn0 ** 2 + damp2 * _rdot(x, x)), 0, 0)
     state = (x, s, r, c, kold, jnp.asarray(0), cost0, cost1_0)
     x, s, r, c, kold, iiter, cost, cost1 = lax.while_loop(cond, body, state)
     return x, iiter, cost, cost1, kold
@@ -400,9 +451,18 @@ def clear_fused_cache() -> None:
     _FUSED_CACHE.clear()
 
 
-def _get_fused(Op, key, make_builder):
+def _get_fused(Op, key, make_builder, donate_argnums=()):
     """Compile (and cache) the fused loop for ``Op``.
-    ``make_builder(op)`` must return the loop with that operator bound.
+    ``make_builder(op)`` must return the loop with that operator bound;
+    the returned fn is called with POSITIONAL runtime operands (the
+    builder calling convention above). ``donate_argnums`` are indices
+    into those operands whose buffers the program may consume in place
+    (the while_loop carry starts in the donated buffer instead of a
+    program-entry copy) — applied only when the precision layer's
+    donation gate is on (``PYLOPS_MPI_TPU_DONATE``), and folded into
+    the cache key so flipping the gate retraces rather than reusing an
+    executable with the wrong aliasing contract.
+
     Registered operator classes (``linearoperator.OP_ARRAY_PYTREES``)
     enter the jitted program as a pytree ARGUMENT — their device
     buffers are traced, not closed over, which multi-process JAX
@@ -410,15 +470,19 @@ def _get_fused(Op, key, make_builder):
     tests/multihost_worker.py). Unregistered operators keep the
     closure form."""
     from ..linearoperator import operator_is_jit_arg
+    from ..ops._precision import donation_enabled
+    donate = tuple(donate_argnums) if donation_enabled() else ()
+    key = key + (donate,)
     entry = _FUSED_CACHE.get(key)
     if entry is None:
         if operator_is_jit_arg(Op):
-            jfn = jax.jit(lambda op, *a, **k: make_builder(op)(*a, **k))
+            jfn = jax.jit(lambda op, *a: make_builder(op)(*a),
+                          donate_argnums=tuple(i + 1 for i in donate))
 
-            def fn(*a, _jfn=jfn, _op=Op, **k):
-                return _jfn(_op, *a, **k)
+            def fn(*a, _jfn=jfn, _op=Op):
+                return _jfn(_op, *a)
         else:
-            fn = jax.jit(make_builder(Op))
+            fn = jax.jit(make_builder(Op), donate_argnums=donate)
         entry = (fn, Op)
         _FUSED_CACHE[key] = entry
         if len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
@@ -428,12 +492,23 @@ def _get_fused(Op, key, make_builder):
     return entry[0]
 
 
+def _donate_copy(v: Vector) -> Vector:
+    """Fresh-buffer copy of a caller-owned vector so the fused entry
+    can donate it: donation consumes the argument's buffer, and the
+    public wrappers must not invalidate a vector the caller may reuse.
+    One eager vector copy per solve — negligible against the solve,
+    and the program-entry copy it replaces was the same bytes."""
+    from ..ops._precision import donation_enabled
+    return v.copy() if donation_enabled() else v
+
+
 def cg(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
        tol: float = 1e-4, show: bool = False, itershow=(10, 10, 10),
        callback: Optional[Callable] = None, fused: Optional[bool] = None
        ) -> Tuple[Vector, int, np.ndarray]:
     """Functional CG (ref ``optimization/basic.py:13-70``). With no
     callback/show, runs the fused on-device loop."""
+    x0_owned = x0 is None  # freshly built → donate without a copy
     if x0 is None:
         x0 = _zero_like_model(Op, y)
     use_fused = fused if fused is not None else (callback is None and not show)
@@ -442,8 +517,9 @@ def cg(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
                          "fused=False for per-iteration hooks")
     if use_fused:
         fn = _get_fused(Op, (id(Op), "cg", niter, _vkey(y), _vkey(x0)),
-                        lambda op: partial(_cg_fused, op, niter=niter))
-        x, iiter, cost = fn(y=y, x0=x0, tol=tol)
+                        lambda op: partial(_cg_fused, op, niter=niter),
+                        donate_argnums=_DONATE_X0)
+        x, iiter, cost = fn(y, x0 if x0_owned else _donate_copy(x0), tol)
         iiter = int(iiter)
         return x, iiter, np.asarray(cost)[:iiter + 1]
     solver = CG(Op)
@@ -463,6 +539,7 @@ def cgls(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
     (``_cgls_fused_normal``) — fastest on memory-bound operators that
     provide a fused ``normal_matvec`` (e.g. batched MPIBlockDiag), but
     its gradient recurrence drifts slightly in f32, so it is opt-in."""
+    x0_owned = x0 is None  # freshly built → donate without a copy
     if x0 is None:
         x0 = _zero_like_model(Op, y)
     use_fused = fused if fused is not None else (callback is None and not show)
@@ -477,8 +554,10 @@ def cgls(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
         builder = _cgls_fused_normal if use_normal else _cgls_fused
         fn = _get_fused(Op, (id(Op), "cgls", use_normal, niter, _vkey(y),
                              _vkey(x0)),
-                        lambda op: partial(builder, op, niter=niter))
-        x, iiter, cost, cost1, kold = fn(y=y, x0=x0, damp=damp, tol=tol)
+                        lambda op: partial(builder, op, niter=niter),
+                        donate_argnums=_DONATE_X0)
+        x, iiter, cost, cost1, kold = fn(
+            y, x0 if x0_owned else _donate_copy(x0), damp, tol)
         iiter = int(iiter)
         istop = 1 if float(jnp.max(kold)) < tol else 2
         cost = np.asarray(cost)[:iiter + 1]

@@ -71,7 +71,12 @@ def _power_run(op, b_in, niter, tol):
     def one_step(b):
         b1 = op.matvec(b)
         maxeig = jnp.asarray(b.dot(b1, vdot=True))
-        return b1 * (1.0 / b1.norm()), maxeig
+        # the norm accumulates at the policy reduction floor (f32 for
+        # narrow spaces); the scale re-enters the update at the carry
+        # dtype so the while_loop pytree stays dtype-stable
+        from .basic import _step_scalar
+        scale = _step_scalar(1.0 / jnp.asarray(b1.norm()), b1.dtype)
+        return b1 * scale, maxeig
 
     def body(state):
         b, maxeig_old, iiter, _ = state
@@ -107,8 +112,11 @@ def _power_iteration_fused(Op, b_k: Vector, niter: int, tol):
     from .basic import _get_fused, _vkey
     if operator_is_jit_arg(Op):
         from functools import partial
+        # b_k is built fresh above (rand_like) — donate it outright:
+        # the normalized-iterate carry starts in its buffer
         fn = _get_fused(Op, (id(Op), "power", _vkey(b_k)),
-                        lambda op: partial(_power_run, op))
+                        lambda op: partial(_power_run, op),
+                        donate_argnums=(0,))
         b_k, maxeig, iiter = fn(b_k, niter, tol)
     else:
         b_k, maxeig, iiter = _power_run(Op, b_k, niter, tol)

@@ -259,9 +259,24 @@ def _ista_fused(Op, y: Vector, x0: Vector, alpha, eps, tol, decay,
     """Whole ISTA/FISTA solve as one ``lax.while_loop``. The eager class
     API pulls 3-4 host floats per iteration (xupdate, costdata, costreg,
     optionally normres); here every scalar stays on device and the
-    threshold/momentum arithmetic fuses into the matvec program."""
+    threshold/momentum arithmetic fuses into the matvec program.
+
+    ``x0`` is DONATED (solvers/basic.py builder convention): the ``x``
+    carry starts in the caller's buffer; the momentum carry ``z``
+    shares the same initial value, so its init is the one unavoidable
+    copy of the donated buffer.
+
+    Dtype discipline (the while_loop carry must hold its dtypes at
+    every iteration — solvers/basic.py ``_step_scalar``): the decay /
+    step / momentum scalars are pinned to the model space's REAL dtype
+    so a float64 python scalar can never promote an f32 carry, and the
+    xupdate/cost scalars live at the policy reduction dtype."""
+    from .basic import _step_scalar, _vdtype
+    from ..ops._precision import reduction_dtype
+    xdt = _vdtype(x0)
+    rdt = reduction_dtype(xdt)
     thresh = eps * alpha * 0.5
-    decay_arr = jnp.asarray(decay)
+    decay_arr = jnp.asarray(decay, dtype=rdt)
     nd = decay_arr.shape[0]
 
     def threshold(v, iiter):
@@ -294,7 +309,8 @@ def _ista_fused(Op, y: Vector, x0: Vector, alpha, eps, tol, decay,
         x, z, t, iiter, cost, _ = state
         xin = z if momentum else x
         res = y - Op.matvec(xin)
-        x_unthresh = xin + Op.rmatvec(res) * alpha
+        x_unthresh = xin + Op.rmatvec(res) * _step_scalar(
+            jnp.asarray(alpha, dtype=rdt), xdt)
         if SOp is not None:
             x_unthresh = SOp.rmatvec(x_unthresh)
         xnew = threshold(x_unthresh, iiter)
@@ -303,14 +319,15 @@ def _ista_fused(Op, y: Vector, x0: Vector, alpha, eps, tol, decay,
         if momentum:
             # Nesterov sequence (ref cls_sparsity.py:645-649)
             tnew = (1.0 + jnp.sqrt(1.0 + 4.0 * t * t)) / 2.0
-            znew = xnew + (xnew - x) * ((t - 1.0) / tnew)
+            znew = xnew + (xnew - x) * _step_scalar((t - 1.0) / tnew,
+                                                    xdt)
             costdata = 0.5 * jnp.max(jnp.asarray(
                 (y - Op.matvec(xnew)).norm())) ** 2
         else:
             tnew, znew = t, xnew
             costdata = 0.5 * jnp.max(jnp.asarray(res.norm())) ** 2
         costreg = eps * jnp.max(jnp.asarray(xnew.norm(1)))
-        xupdate = jnp.max(jnp.asarray((xnew - x).norm()))
+        xupdate = jnp.max(jnp.asarray((xnew - x).norm())).astype(rdt)
         cost = lax.dynamic_update_index_in_dim(
             cost, (costdata + costreg).astype(cost.dtype), iiter, 0)
         return (_relayout_like(x, xnew), _relayout_like(z, znew), tnew,
@@ -319,18 +336,19 @@ def _ista_fused(Op, y: Vector, x0: Vector, alpha, eps, tol, decay,
     def cond(state):
         return (state[3] < niter) & (state[5] > tol)
 
-    x = x0.copy()
-    z = x0.copy()
-    t0 = jnp.asarray(1.0)
+    x = x0          # donated: carry aliases the caller's buffer
+    z = x0.copy()   # second carry from the same buffer: one real copy
+    t0 = jnp.asarray(1.0, dtype=rdt)
     cost0 = jnp.zeros((niter,), dtype=t0.dtype)
-    state = (x, z, t0, jnp.asarray(0), cost0, jnp.asarray(jnp.inf))
+    state = (x, z, t0, jnp.asarray(0), cost0,
+             jnp.asarray(jnp.inf, dtype=rdt))
     x, z, t, iiter, cost, xupdate = lax.while_loop(cond, body, state)
     return x, iiter, cost
 
 
 def _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha, eigsdict, tol,
                         threshkind, decay, momentum):
-    from .basic import _get_fused, _vkey
+    from .basic import _get_fused, _vkey, _donate_copy, _DONATE_X0
 
     if threshkind not in _THRESHF:
         raise NotImplementedError("threshkind should be hard, soft or half")
@@ -366,9 +384,10 @@ def _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha, eigsdict, tol,
     fn = _get_fused(Op, key,
                     lambda op: partial(_ista_fused, op, niter=niter,
                                        threshf=_THRESHF[threshkind],
-                                       SOp=SOp, momentum=momentum))
-    x, iiter, cost = fn(y=y, x0=x0, alpha=alpha, eps=eps, tol=tol,
-                        decay=jnp.asarray(decay))
+                                       SOp=SOp, momentum=momentum),
+                    donate_argnums=_DONATE_X0)
+    x, iiter, cost = fn(y, _donate_copy(x0), alpha, eps, tol,
+                        jnp.asarray(decay))
     iiter = int(iiter)
     return x, iiter, np.asarray(cost)[:iiter]
 

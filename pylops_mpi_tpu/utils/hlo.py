@@ -21,14 +21,15 @@ runtimes without complex lowering.
 from __future__ import annotations
 
 import re
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 import jax
 
 __all__ = ["collective_report", "assert_no_full_gather",
            "parse_hlo_collectives", "complex_dtype_lines",
-           "assert_complex_free"]
+           "assert_complex_free", "compiled_hlo", "count_ops",
+           "assert_max_converts", "donation_report", "assert_donation"]
 
 # HLO opcode -> canonical name; bytes counted from the result shape
 _COLLECTIVE_OPS = ("all-gather", "all-reduce", "all-to-all",
@@ -140,6 +141,148 @@ def assert_complex_free(fn, *args, **kwargs):
             f"program contains {len(lines)} complex-dtype instruction "
             f"line(s); first few:\n{head}")
     return parse_hlo_collectives(hlo)
+
+
+def compiled_hlo(fn, *args, **kwargs) -> str:
+    """Optimized HLO text of ``fn(*args, **kwargs)`` (jit-wrapping if
+    needed) — the shared entry for every pin below."""
+    jfn = fn if hasattr(fn, "lower") else jax.jit(fn)
+    return jfn.lower(*args, **kwargs).compile().as_text()
+
+
+def count_ops(hlo: str, opcode: str, shape_re: Optional[str] = None,
+              computation_re: Optional[str] = None) -> int:
+    """Count instructions of ``opcode`` in HLO text.
+
+    ``shape_re`` restricts to instructions whose RESULT shape string
+    (e.g. ``f32[8,512,512]``) matches the regex — the handle for
+    per-A-tile pins ("how many converts touch a block-stack-shaped
+    buffer?"). ``computation_re`` restricts to instructions inside
+    computations whose name matches (e.g. ``r"body"`` for the
+    ``while``-loop body region, so per-iteration counts don't include
+    setup converts). Counting is text-level on the optimized HLO, the
+    same layer the collective pins use."""
+    op_re = re.compile(r"\b" + re.escape(opcode) + r"(?:\.\d+)?\(")
+    shape_pat = re.compile(shape_re) if shape_re else None
+    comp_pat = re.compile(computation_re) if computation_re else None
+    # computation headers: "%region_1.42 (p: f32[...]) -> ... {",
+    # "ENTRY %main.33 (...) -> ... {"
+    header_re = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\{\s*$")
+    n = 0
+    in_scope = comp_pat is None
+    for line in hlo.splitlines():
+        ls = line.strip()
+        hm = header_re.match(ls)
+        if hm is not None:
+            in_scope = comp_pat is None or bool(comp_pat.search(hm.group(1)))
+            continue
+        if not in_scope or "=" not in ls:
+            continue
+        # "%convert.51 = bf16[512]{0} convert(f32[512]{0} %p), ..." —
+        # the opcode is the first call-form token after the result type
+        rhs = ls.split("=", 1)[1]
+        m = op_re.search(rhs)
+        if m is None or (m.start() > 0 and rhs[m.start() - 1] == "%"):
+            continue
+        if shape_pat is not None and not shape_pat.search(rhs[:m.start()]):
+            continue
+        n += 1
+    return n
+
+
+def assert_max_converts(fn, *args, max_converts: int = 0,
+                        shape_re: Optional[str] = None,
+                        computation_re: Optional[str] = None, **kwargs):
+    """Compile and raise ``AssertionError`` if the program holds more
+    than ``max_converts`` dtype-convert instructions (optionally
+    restricted by result shape / computation, see :func:`count_ops`).
+    This is the mixed-precision pin: a bf16-storage fused solver may
+    widen each A tile at the GEMM operand (≤2 per iteration — matvec +
+    rmatvec) but must not convert per-element wide copies of anything
+    else. Returns the count."""
+    hlo = compiled_hlo(fn, *args, **kwargs)
+    n = count_ops(hlo, "convert", shape_re=shape_re,
+                  computation_re=computation_re)
+    if n > max_converts:
+        lines = [ln.strip()[:160] for ln in hlo.splitlines()
+                 if " convert(" in ln or re.search(r"convert\.\d+\(", ln)]
+        head = "\n".join(lines[:8])
+        raise AssertionError(
+            f"program contains {n} convert op(s) (> {max_converts})"
+            + (f" matching shape {shape_re!r}" if shape_re else "")
+            + f"; first few:\n{head}")
+    return n
+
+
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([0-9, ]*)\}:\s*\((\d+)\s*,\s*\{([0-9, ]*)\}")
+
+
+def _alias_blob(hlo: str) -> str:
+    """The brace-balanced ``input_output_alias={...}`` attribute value
+    from the module header (empty string when absent)."""
+    start = hlo.find("input_output_alias={")
+    if start < 0:
+        return ""
+    i = hlo.index("{", start)
+    depth = 0
+    for j in range(i, min(len(hlo), i + 20000)):
+        if hlo[j] == "{":
+            depth += 1
+        elif hlo[j] == "}":
+            depth -= 1
+            if depth == 0:
+                return hlo[i + 1:j]
+    return ""
+
+
+def donation_report(fn, *args, **kwargs) -> Dict:
+    """Compile and report buffer donation: which entry parameters are
+    aliased to outputs (``input_output_alias`` on the HLO module —
+    donation's footprint in the compiled program), and how many
+    ``copy`` instructions read a donated parameter (the copies the
+    donation was supposed to eliminate). Keys: ``aliased_params``
+    (sorted param numbers), ``donated_param_copies``."""
+    hlo = compiled_hlo(fn, *args, **kwargs)
+    return parse_donation(hlo)
+
+
+def parse_donation(hlo: str) -> Dict:
+    """Text-level donation report (exposed for direct testing)."""
+    params = set()
+    for mm in _ALIAS_ENTRY_RE.finditer(_alias_blob(hlo)):
+        params.add(int(mm.group(2)))
+    # copies consuming a donated parameter: the donated Arg should be
+    # written in place, not defensively copied
+    n_copies = 0
+    if params:
+        arg_names = "|".join(rf"Arg_{p}\." for p in sorted(params))
+        pat = re.compile(r"\bcopy(?:\.\d+)?\([^)]*%(?:" + arg_names + r")")
+        for line in hlo.splitlines():
+            if pat.search(line):
+                n_copies += 1
+    return {"aliased_params": sorted(params),
+            "donated_param_copies": n_copies}
+
+
+def assert_donation(fn, *args, min_aliased: int = 1, **kwargs) -> Dict:
+    """Compile and raise ``AssertionError`` unless at least
+    ``min_aliased`` entry parameters are donation-aliased to outputs
+    AND no ``copy`` instruction reads a donated parameter — the
+    zero-copy while_loop-state pin for the fused solvers (a donated
+    ``x0`` must become the loop carry in place). Returns the report."""
+    rep = donation_report(fn, *args, **kwargs)
+    if len(rep["aliased_params"]) < min_aliased:
+        raise AssertionError(
+            f"expected >= {min_aliased} donation-aliased parameters, "
+            f"found {rep['aliased_params']} — was the entry compiled "
+            "without donate_argnums (PYLOPS_MPI_TPU_DONATE=0?)")
+    if rep["donated_param_copies"]:
+        raise AssertionError(
+            f"{rep['donated_param_copies']} copy op(s) read a donated "
+            "parameter: the donated buffer is being defensively copied "
+            "instead of aliased in place")
+    return rep
 
 
 def assert_no_full_gather(fn, *args, max_fraction: float = 0.5, **kwargs):

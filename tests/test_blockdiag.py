@@ -263,7 +263,11 @@ def test_vstack_dtypes(rng):
         mats = [rng.standard_normal((3, 12)).astype(dt) for _ in range(8)]
         if np.issubdtype(dt, np.complexfloating):
             mats = [m + 1j * rng.standard_normal((3, 12)) for m in mats]
-        Op = MPIVStack([MatrixMult(m, dtype=dt) for m in mats])
+        # explicit compute_dtype: this is a full-precision dtype-semantics
+        # check — the env precision policy must not narrow the storage
+        # (the mixed-precision CI leg runs this file under bf16)
+        Op = MPIVStack([MatrixMult(m, dtype=dt) for m in mats],
+                       compute_dtype=dt)
         dense = np.vstack(mats)
         x = rng.standard_normal(12).astype(dt)
         dx = DistributedArray.to_dist(x, partition=Partition.BROADCAST)
@@ -312,7 +316,11 @@ def test_vstack_compute_dtype_bf16(rng):
     import jax.numpy as jnp
     mats = [rng.standard_normal((4, 12)).astype(np.float32)
             for _ in range(P)]
-    Op32 = MPIVStack([MatrixMult(m, dtype=np.float32) for m in mats])
+    # the f32 control pins its storage: under the mixed-precision CI
+    # leg (PYLOPS_MPI_TPU_PRECISION=bf16) a policy-defaulted stack
+    # would narrow too and the bf16-vs-f32 gap would vanish
+    Op32 = MPIVStack([MatrixMult(m, dtype=np.float32) for m in mats],
+                     compute_dtype=np.float32)
     Opbf = MPIVStack([MatrixMult(m, dtype=np.float32) for m in mats],
                      compute_dtype=jnp.bfloat16)
     assert Opbf._batched.dtype == jnp.bfloat16
@@ -342,7 +350,9 @@ def test_hstack_compute_dtype_and_complex_guard(rng):
     import pytest as _pytest
     mats = [rng.standard_normal((12, 4)).astype(np.float32)
             for _ in range(P)]
-    Op32 = MPIHStack([MatrixMult(m, dtype=np.float32) for m in mats])
+    # f32 control pinned explicitly (see test_vstack_compute_dtype_bf16)
+    Op32 = MPIHStack([MatrixMult(m, dtype=np.float32) for m in mats],
+                     compute_dtype=np.float32)
     Opbf = MPIHStack([MatrixMult(m, dtype=np.float32) for m in mats],
                      compute_dtype=jnp.bfloat16)
     assert Opbf.vstack._batched_adj is True

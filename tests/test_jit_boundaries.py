@@ -70,11 +70,12 @@ def test_masked_solve_single_program(rng):
     dy = DistributedArray.to_dist(dense @ xtrue, mask=mask)
     x0 = DistributedArray.to_dist(np.zeros(4 * P), mask=mask)
 
-    fn = jax.jit(lambda y, x: _cg_fused(Op, y, x, 100, 1e-13)[0])
+    fn = jax.jit(lambda y, x: _cg_fused(Op, y, x, 1e-13, niter=100)[0])
     got = fn(dy, x0)
     np.testing.assert_allclose(got.asarray(), xtrue, rtol=1e-6, atol=1e-8)
     # the loop is a single while op, not an unrolled chain
-    jaxpr = jax.make_jaxpr(lambda y, x: _cg_fused(Op, y, x, 100, 1e-13)[0])(
+    jaxpr = jax.make_jaxpr(
+        lambda y, x: _cg_fused(Op, y, x, 1e-13, niter=100)[0])(
         dy, x0)
     prims = [e.primitive.name for e in jaxpr.eqns]
     assert "while" in prims
@@ -96,7 +97,8 @@ def test_stacked_solver_jit(rng):
     dx = DistributedArray.to_dist(xtrue)
     data = SG.matvec(dx)
 
-    fn = jax.jit(lambda y, x: _cgls_fused(SG, y, x, 400, 0.0, 0.0)[0])
+    fn = jax.jit(lambda y, x: _cgls_fused(SG, y, x, 0.0, 0.0,
+                                          niter=400)[0])
     got = fn(data, dx.zeros_like())
     import scipy.linalg as spla
     dense_B = spla.block_diag(*mats)
@@ -138,7 +140,7 @@ def test_fused_solver_no_host_sync_per_iter(rng):
     dy = DistributedArray.to_dist(rng.standard_normal(4 * P))
     x0 = dy.zeros_like()
     hlo = jax.jit(
-        lambda y, x: _cgls_fused(Op, y, x, 50, 0.0, 0.0)[0]._arr
+        lambda y, x: _cgls_fused(Op, y, x, 0.0, 0.0, niter=50)[0]._arr
     ).lower(dy, x0).compile().as_text()
     assert hlo.count("while") >= 1
     # 50 iterations must NOT appear as 50 unrolled GEMM pairs
@@ -160,7 +162,7 @@ def test_ragged_vectors_through_fused_solver(rng):
     xtrue = rng.standard_normal(n)
     dy = DistributedArray.to_dist(dense @ xtrue,
                                   local_shapes=Op.local_shapes_n)
-    fn = jax.jit(lambda y, x: _cg_fused(Op, y, x, 120, 1e-13)[0])
+    fn = jax.jit(lambda y, x: _cg_fused(Op, y, x, 1e-13, niter=120)[0])
     got = fn(dy, dy.zeros_like())
     np.testing.assert_allclose(got.asarray(), xtrue, rtol=1e-6, atol=1e-8)
 
@@ -189,7 +191,7 @@ def test_fused_cgls_collective_schedule_is_scalar_only(rng):
         if cd is not None and not Op.has_fused_normal:
             solver = _cgls_fused
         rep = collective_report(
-            lambda yy, xx: solver(Op, yy, xx, 20, 0.0, 0.0)[0].array,
+            lambda yy, xx: solver(Op, yy, xx, 0.0, 0.0, niter=20)[0].array,
             y, y.zeros_like())
         # NOTHING but scalar all-reduces — any other collective kind
         # (gather, permute, reduce-scatter, ...) is a layout regression
