@@ -1025,6 +1025,22 @@ def _merge_tpu_cache(result, root=None):
                                   if v.get("error") else {})}
                            for k, v in probes.items()
                            if isinstance(v, dict)}}
+    ent = cache.get("overlap") or {}
+    r = ent.get("result")
+    # overlap-race stage (round 8): hardware evidence only — the CPU
+    # rows are banked by the live components sweep anyway, and a
+    # rehearsal must never read as an ICI measurement
+    if (r and isinstance(r.get("rows"), list)
+            and r.get("platform") == "tpu" and "tpu_overlap" not in result):
+        result["tpu_overlap"] = {
+            "ts": ent.get("ts"), "code_rev": ent.get("code_rev"),
+            "rows": [{k: row.get(k) for k in
+                      ("bench", "value", "pipelined_vs_bulk", "schedule",
+                       "stat_a_pipelined_vs_bulk", "ring_steps",
+                       "ici_bytes_per_step", "comm_chunks", "a2a_count",
+                       "ici_bytes_per_chunk", "shape", "error")
+                      if row.get(k) is not None}
+                     for row in r["rows"] if isinstance(row, dict)]}
     ent = cache.get("diag") or {}
     r = ent.get("result")
     # same hardware-evidence rule as the selfcheck merge above: a diag
@@ -1142,6 +1158,11 @@ def _compact_line(result):
             "vs_sweep": bd.get("while_loop_marginal_vs_sweep"),
             "reduction_ms": bd.get("reduction_overhead_per_iter_ms"),
             "dispatch_ms": bd.get("dispatch_ms")}
+    ov = result.get("tpu_overlap") or {}
+    if ov:
+        compact["overlap"] = {
+            row.get("bench"): row.get("pipelined_vs_bulk")
+            for row in ov.get("rows", []) if isinstance(row, dict)}
     fp = result.get("tpu_fft_planar") or {}
     if fp:
         pr = fp.get("probes") or {}
@@ -1157,8 +1178,8 @@ def _compact_line(result):
                             "last_ts": probe.get("last_ts")}
     # hard ≤2KB guarantee: shed optional detail, most-expendable first
     for victim in ("probe", "components", "bf16_race", "bf16", "f32",
-                   "flagship_1dev_cpu", "tpu_breakdown", "fft_planar",
-                   "selfcheck"):
+                   "flagship_1dev_cpu", "tpu_breakdown", "overlap",
+                   "fft_planar", "selfcheck"):
         if len(json.dumps(compact)) <= 2000:
             break
         compact.pop(victim, None)

@@ -2,14 +2,18 @@
 
 Proves, without hardware, that a live TPU window will be spent
 correctly: the exact probe-daemon stage sequence
-(selfcheck → small → fft_planar → full → mid → bisect → breakdown →
-diag; the round-6 reorder banks the planar-FFT verdict and the
-N=4096 headline BEFORE the 900 s diagnosis stages) runs on a CPU
-8-virtual-device mesh in TPU ordering (headline banked before
-components), every stage banks a result within its configured budget,
-the persistent XLA compile cache hits across the bench child
-processes, a killed full run still salvages its headline, and
-rehearsal artifacts can never be promoted as TPU evidence.
+(selfcheck → small → fft_planar → full → mid → overlap → bisect →
+breakdown → diag; the round-6 reorder banks the planar-FFT verdict and
+the N=4096 headline BEFORE the 900 s diagnosis stages, and the round-8
+overlap races sit after the flagship rungs so they can never push the
+headline back) runs on a CPU 8-virtual-device mesh in TPU ordering
+(headline banked before components), every stage banks a result within
+its configured budget, the persistent XLA compile cache hits across
+the bench child processes, a killed full run still salvages its
+headline, a breakdown child killed MID-STAGE still banks every
+section completed before the kill (the per-section partial-line
+banking, proven here by an injected kill), and rehearsal artifacts can
+never be promoted as TPU evidence.
 
 Run: ``python benchmarks/rehearse_ladder.py [--fast]``
 (``--fast`` shrinks the full rung to N=2048 so the whole rehearsal
@@ -36,7 +40,7 @@ sys.path.insert(0, _HERE)  # for tpu_probe_loop.rehearse_env
 
 BUDGETS = {  # seconds; the real window budgets this rehearsal enforces
     "selfcheck": 600, "flagship_small": 600, "fft_planar": 600,
-    "breakdown": 700, "diag": 700, "flagship_mid": 1200,
+    "overlap": 600, "breakdown": 700, "diag": 700, "flagship_mid": 1200,
     "flagship_full": 2400,
 }
 
@@ -158,6 +162,34 @@ def main() -> None:
                         or r3.get("components") is not None)),
         **({"error": e3} if e3 else {})}
 
+    # ---- pass 3b: breakdown mid-stage kill — the per-section
+    # partial-line banking (landed post-window, unproven until now)
+    # must salvage every section completed before the kill. The niter
+    # sweep is given an absurd final point so the kill ALWAYS lands
+    # mid-sweep, machine speed notwithstanding. ----
+    env4 = dict(env2)
+    env4["BREAKDOWN_NBLOCK"] = "1024"
+    env4["BREAKDOWN_NITERS"] = "1,5,1000000"   # last point outlives any kill
+    kill_after = int(os.environ.get("REHEARSE_BREAKDOWN_KILL_S", "90"))
+    t0 = time.time()
+    r4, e4 = bench._run_json_cmd(
+        [sys.executable, os.path.join(_HERE, "tpu_breakdown.py")],
+        env4, timeout=kill_after, cwd=_ROOT)
+    banked = sorted(k for k in (r4 or {})
+                    if k in ("dispatch_ms", "matvec_ms", "sweep_ms",
+                             "niter_points_partial"))
+    art["breakdown_salvage"] = {
+        "kill_after_s": kill_after,
+        "wall_s": round(time.time() - t0, 1),
+        "was_killed": bool(r4 and r4.get("salvaged_after_timeout")),
+        "partial_flag": bool(r4 and r4.get("partial")),
+        "banked_sections": banked,
+        # proof = the child was killed mid-stage AND the salvaged line
+        # carries completed sections with the partial marker
+        "ok": bool(r4 and r4.get("salvaged_after_timeout")
+                   and r4.get("partial") and "dispatch_ms" in banked),
+        **({"error": e4} if e4 else {})}
+
     # ---- pass 4: rehearsal caches must NEVER read as TPU evidence ----
     merged = bench._merge_tpu_cache(
         {"platform": "cpu", "value": 1.0, "degraded": True},
@@ -167,6 +199,7 @@ def main() -> None:
         "cached": bool(merged.get("cached"))}
 
     art["ok"] = bool(art["ladder_ok"] and art["salvage"]["ok"]
+                     and art["breakdown_salvage"]["ok"]
                      and art["no_false_promotion"]["ok"])
     out_path = os.path.join(_HERE, "rehearsal_r04.json")
     with open(out_path, "w") as f:
@@ -175,6 +208,8 @@ def main() -> None:
                       "ladder_ok": art["ladder_ok"],
                       "cache_ok": art["compile_cache"].get("ok"),
                       "salvage_ok": art["salvage"]["ok"],
+                      "breakdown_salvage_ok":
+                          art["breakdown_salvage"]["ok"],
                       "no_false_promotion":
                           art["no_false_promotion"]["ok"],
                       "artifact": out_path}))

@@ -17,7 +17,9 @@ round-long harvest:
   1. ``tpu_selfcheck`` — every Pallas kernel + hot path vs oracles
      (seconds of TPU time; catches Mosaic failures first);
   2. small flagship — N=1024, 20 iters (seconds);
-  3. full flagship — the default N=4096 headline + components.
+  3. full flagship — the default N=4096 headline + components;
+  4. post-flagship measurement stages: the overlap schedule races
+     (round 8), then the diagnosis stages (bisect/breakdown/diag).
 
 ``bench.py`` merges the cache and the probe log into its JSON output,
 so the round artifact contains a TPU number if *any* probe during the
@@ -174,6 +176,20 @@ def _stage_fft_planar(env):
         cwd=_ROOT)
 
 
+def _stage_overlap(env):
+    """Bulk-vs-pipelined schedule races (round 8): the summa_overlap
+    and pencil_a2a_chunked rows in one subprocess
+    (bench_components.py --overlap-stage). On hardware the rows stamp
+    ICI bytes/step and chunk counts; slotted AFTER the flagship stages
+    so the north-star N=4096 number is never pushed back by schedule
+    races."""
+    return _bench_mod()._run_json_cmd(
+        [sys.executable, "-u",
+         os.path.join(_HERE, "bench_components.py"), "--overlap-stage"],
+        env, timeout=int(os.environ.get("PROBE_OVERLAP_TIMEOUT", "600")),
+        cwd=_ROOT)
+
+
 def _stage_breakdown(env):
     """Latency attribution for the flagship (benchmarks/tpu_breakdown.py):
     fixed-vs-marginal niter fit, standalone sweep time, reduction
@@ -261,6 +277,9 @@ def harvest(cache: dict, rehearse: bool = False) -> dict:
         ("fft_planar", lambda: _stage_fft_planar(env)),
         ("flagship_full", lambda: _stage_flagship(env, "full")),
         ("flagship_mid", lambda: _stage_flagship(env, "mid")),
+        # overlap races sit AFTER the flagship stages by design (ISSUE
+        # 3): a schedule race must never push the N=4096 headline back
+        ("overlap", lambda: _stage_overlap(env)),
         ("bisect", lambda: _stage_bisect(env)),
         ("breakdown", lambda: _stage_breakdown(env)),
         ("diag", lambda: _stage_diag(env)),

@@ -117,10 +117,19 @@ def _stencil_spec(op) -> Optional[dict]:
 class _StencilOperator(MPILinearOperator):
     """Common scaffolding: flat vector in → N-D stencil → flat vector out,
     with the reference's BROADCAST→SCATTER input conversion
-    (ref ``FirstDerivative.py:128-132``) and axis-0 row-sharded output."""
+    (ref ``FirstDerivative.py:128-132``) and axis-0 row-sharded output.
 
-    def __init__(self, dims, mesh=None, dtype=None):
+    ``overlap`` (``PYLOPS_MPI_TPU_OVERLAP``) selects the
+    compute/comm-overlapped form of the explicit stencil kernel: the
+    ghost ``ppermute``\\ s are issued first and consumed ONLY by the
+    ``w``-row boundary patches, so the interior stencil — the bulk of
+    the FLOPs — carries no dependence on the exchange and runs while
+    the slabs fly (round 8; see :meth:`_apply_explicit`)."""
+
+    def __init__(self, dims, mesh=None, dtype=None, overlap=None):
+        from ..utils.deps import overlap_enabled
         self.dims_nd = _tuplize(dims)
+        self._overlap = overlap_enabled(overlap)
         n = int(np.prod(self.dims_nd))
         from ..parallel.mesh import default_mesh
         self.mesh = mesh if mesh is not None else default_mesh()
@@ -227,6 +236,14 @@ class _StencilOperator(MPILinearOperator):
         valid_tab = jnp.asarray(rows_tab, dtype=jnp.int32)
         base_tab = jnp.asarray(np.concatenate([[0], np.cumsum(rows_tab)[:-1]]),
                                dtype=jnp.int32)
+        # compute/comm overlap (round 8): split the stencil into the
+        # interior (needs no ghosts — the bulk of the work) and the two
+        # w-row boundary patches (the only consumers of the ppermuted
+        # slabs), so the exchange flies while the interior computes.
+        # Requires every shard to hold the 2w rows each patch reads
+        # locally; shorter shards keep the bulk ghosted-slab kernel.
+        use_overlap = (self._overlap and P_ > 1 and w > 0
+                       and min(rows_tab) >= 2 * w)
 
         def kernel(xb):
             b = xb.reshape((rmax,) + tuple(dims[1:]))
@@ -241,14 +258,49 @@ class _StencilOperator(MPILinearOperator):
             if not forward:  # (Z·S)ᴴ = Sᵀ·Z: zero the masked input rows
                 zin = (G < lo_z) | (G > n0 - 1 - hi_z)
                 b = jnp.where(zin, zero, b)
-            slab = halo_slab(b, axis_name, P_, 0, w, w, valid, rmax,
-                             ragged)
-            if pallas_core is not None:
-                y = pallas_core(slab)
+            if use_overlap:
+                from ..parallel.collectives import ring_halo_ghosts
+                # ghosts first: consumed only by the boundary patches
+                gf, gb = ring_halo_ghosts(b, axis_name, P_, w, w, valid)
+                # interior: the zero-extended local slab — exact
+                # everywhere except the first/last w valid rows
+                padw = [(w, w)] + [(0, 0)] * (b.ndim - 1)
+                zslab = jnp.pad(b, padw)
+                if pallas_core is not None:
+                    y = pallas_core(zslab)
+                else:
+                    y = sum(c * lax.slice_in_dim(zslab, w + d,
+                                                 w + d + rmax, axis=0)
+                            for d, c in taps.items())
+
+                def tap_rows(sl, nrows):
+                    return sum(c * lax.slice_in_dim(sl, w + d,
+                                                    w + d + nrows,
+                                                    axis=0)
+                               for d, c in taps.items())
+
+                # patch rows [0, w): slab rows [0, 3w) = [gf; b[:2w]]
+                top_in = jnp.concatenate(
+                    [gf, lax.slice_in_dim(b, 0, 2 * w, axis=0)], axis=0)
+                y = jnp.concatenate(
+                    [tap_rows(top_in, w),
+                     lax.slice_in_dim(y, w, rmax, axis=0)], axis=0)
+                # patch rows [valid-w, valid): slab rows
+                # [valid-2w, valid+w) = [b[valid-2w:valid]; gb]
+                bot_in = jnp.concatenate(
+                    [lax.dynamic_slice_in_dim(b, valid - 2 * w, 2 * w,
+                                              axis=0), gb], axis=0)
+                y = lax.dynamic_update_slice_in_dim(
+                    y, tap_rows(bot_in, w), valid - w, axis=0)
             else:
-                y = sum(c * lax.slice_in_dim(slab, w + d, w + d + rmax,
-                                             axis=0)
-                        for d, c in taps.items())
+                slab = halo_slab(b, axis_name, P_, 0, w, w, valid, rmax,
+                                 ragged)
+                if pallas_core is not None:
+                    y = pallas_core(slab)
+                else:
+                    y = sum(c * lax.slice_in_dim(slab, w + d,
+                                                 w + d + rmax, axis=0)
+                            for d, c in taps.items())
             if forward and (lo_z or hi_z):
                 y = jnp.where((G < lo_z) | (G > n0 - 1 - hi_z), zero, y)
             if triples:
@@ -290,8 +342,8 @@ class MPIFirstDerivative(_StencilOperator):
 
     def __init__(self, dims, sampling: float = 1.0, kind: str = "centered",
                  edge: bool = False, order: int = 3, mesh=None,
-                 dtype=np.float64):
-        super().__init__(dims, mesh=mesh, dtype=dtype)
+                 dtype=np.float64, overlap=None):
+        super().__init__(dims, mesh=mesh, dtype=dtype, overlap=overlap)
         self.sampling = sampling
         self.kind = kind
         self.edge = edge
@@ -315,8 +367,9 @@ class MPISecondDerivative(_StencilOperator):
     the edge of the global array)."""
 
     def __init__(self, dims, sampling: float = 1.0, kind: str = "centered",
-                 edge: bool = False, mesh=None, dtype=np.float64):
-        super().__init__(dims, mesh=mesh, dtype=dtype)
+                 edge: bool = False, mesh=None, dtype=np.float64,
+                 overlap=None):
+        super().__init__(dims, mesh=mesh, dtype=dtype, overlap=overlap)
         self.sampling = sampling
         self.kind = kind
         self.edge = edge
@@ -372,7 +425,8 @@ class MPIGradient(MPILinearOperator):
     component per axis."""
 
     def __init__(self, dims, sampling=1, kind: str = "centered",
-                 edge: bool = False, mesh=None, dtype=np.float64):
+                 edge: bool = False, mesh=None, dtype=np.float64,
+                 overlap=None):
         self.dims_nd = _tuplize(dims)
         ndims = len(self.dims_nd)
         # NOT _tuplize: sampling is a float spacing, an int cast would
@@ -390,7 +444,8 @@ class MPIGradient(MPILinearOperator):
         for ax in range(ndims):
             op = _AxisFirstDerivative(self.dims_nd, axis=ax,
                                       sampling=sampling[ax], kind=kind,
-                                      edge=edge, mesh=mesh, dtype=dtype)
+                                      edge=edge, mesh=mesh, dtype=dtype,
+                                      overlap=overlap)
             grad_ops.append(op)
         stack = MPIStackedVStack(grad_ops)
         super().__init__(shape=stack.shape, dtype=np.dtype(dtype))
@@ -410,8 +465,8 @@ class _AxisFirstDerivative(_StencilOperator):
     inside MPIBlockDiag, ref ``Gradient.py:88-97``)."""
 
     def __init__(self, dims, axis, sampling, kind, edge, mesh=None,
-                 dtype=np.float64):
-        super().__init__(dims, mesh=mesh, dtype=dtype)
+                 dtype=np.float64, overlap=None):
+        super().__init__(dims, mesh=mesh, dtype=dtype, overlap=overlap)
         self._op = _LocalFirst(self.dims_nd, axis=axis, sampling=sampling,
                                kind=kind, edge=edge, dtype=dtype)
 

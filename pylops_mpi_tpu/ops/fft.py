@@ -34,6 +34,17 @@ program with zero complex-dtype ops, collectives included (pinned by
 transforms the all-to-all carries the half-spectrum as two f32 planes
 — about half the bytes of the complex engine's full-spectrum c64
 schedule (``pencil_fft2d_planar`` bench row).
+
+Pipelined pencil transposes (round 8, ``PYLOPS_MPI_TPU_OVERLAP`` /
+``overlap=`` / ``comm_chunks=``): with the overlap enabled, each
+aligned-path transpose streams as K tiled ``all_to_all`` chunks along
+``out_ax``, every chunk chased immediately by its slice of the axis-0
+transform section, so chunk ``k``'s ICI transfer flies while chunk
+``k±1`` transforms (arXiv 2112.01075's chunked redistribution;
+``parallel.collectives.chunked_pencil_transpose``). K all-to-alls per
+transpose are pinned in CI; ``off`` keeps the bulk single-collective
+kernels bit-identical, and chunk counts that don't fit the axis fall
+back with a logged note.
 """
 
 from __future__ import annotations
@@ -71,7 +82,19 @@ class _MPIBaseFFTND(MPILinearOperator):
 
     def __init__(self, dims, axes, nffts=None, sampling=1.0, norm="none",
                  real=False, ifftshift_before=False, fftshift_after=False,
-                 mesh=None, dtype="complex128"):
+                 mesh=None, dtype="complex128", overlap=None,
+                 comm_chunks=None):
+        from ..utils.deps import overlap_enabled, comm_chunks_default
+        # pipelined pencil transposes (round 8): when the overlap is
+        # enabled the two aligned-path all-to-alls stream as
+        # `comm_chunks` tiled chunks interleaved with the per-chunk
+        # axis-0 transforms (collectives.chunked_pencil_transpose);
+        # off = the bulk single-collective schedule, bit-identical.
+        self._overlap = overlap_enabled(overlap)
+        if comm_chunks is not None and int(comm_chunks) < 1:
+            raise ValueError(f"comm_chunks={comm_chunks}: must be >= 1")
+        self._comm_chunks = (int(comm_chunks) if comm_chunks is not None
+                             else comm_chunks_default())
         self.dims_nd = tuple(int(d) for d in np.atleast_1d(dims))
         ndim = len(self.dims_nd)
         axes = tuple(ax % ndim for ax in np.atleast_1d(axes))
@@ -173,6 +196,17 @@ class _MPIBaseFFTND(MPILinearOperator):
         return self._dlocals
 
     # ------------------------------------------------------------- helpers
+    def _pencil_chunks(self, width: int, P: int) -> int:
+        """Effective chunk count for the streamed pencil transposes at
+        this operator's settings (1 = bulk): the overlap seam gates it,
+        and chunk counts that don't fit the axis fall back with a
+        logged note (collectives.resolve_chunks) instead of erroring."""
+        if not self._overlap or P <= 1:
+            return 1
+        from ..parallel.collectives import resolve_chunks
+        return resolve_chunks(width, P, self._comm_chunks,
+                              where=f"{type(self).__name__} pencil")
+
     def _shift_axes(self, flags) -> Tuple[int, ...]:
         return tuple(int(ax) for ax, f in zip(self.axes, flags) if f)
 
@@ -372,19 +406,33 @@ class _MPIBaseFFTND(MPILinearOperator):
             if self.real:
                 b = self._scale_real(b, inverse=False)
             if 0 in axes:
-                b = self._block_transpose(b, axis_name, P, out_ax)
-                b = jnp.take(b, unpad_m, axis=0)       # exact dims[0]
-                if 0 in shift_before:
-                    b = jnp.fft.ifftshift(b, axes=(0,))
-                b = dft.fft(b, n=nfft0, axis=0)    # exact dimsd[0]
-                if 0 in shift_after:
-                    b = jnp.fft.fftshift(b, axes=(0,))
-                b = jnp.take(b, pad_d_src, axis=0)     # per-shard padded
-                m = pad_d_mask.reshape((-1,) + (1,) * (b.ndim - 1))
-                b = jnp.where(m, b, jnp.zeros((), dtype=b.dtype))
-                if P > 1:
-                    b = lax.all_to_all(b, axis_name, split_axis=0,
-                                       concat_axis=out_ax, tiled=True)
+                # the axis-0 section between the two pencil transposes;
+                # pure axis-0 work, so it runs unchanged on out_ax tiles
+                # when the transpose streams in chunks (overlap on)
+                def mid(bb):
+                    bb = jnp.take(bb, unpad_m, axis=0)   # exact dims[0]
+                    if 0 in shift_before:
+                        bb = jnp.fft.ifftshift(bb, axes=(0,))
+                    bb = dft.fft(bb, n=nfft0, axis=0)    # exact dimsd[0]
+                    if 0 in shift_after:
+                        bb = jnp.fft.fftshift(bb, axes=(0,))
+                    bb = jnp.take(bb, pad_d_src, axis=0)  # per-shard pad
+                    m = pad_d_mask.reshape((-1,) + (1,) * (bb.ndim - 1))
+                    return jnp.where(m, bb,
+                                     jnp.zeros((), dtype=bb.dtype))
+
+                K = self._pencil_chunks(b.shape[out_ax], P)
+                if K > 1:
+                    from ..parallel.collectives import \
+                        chunked_pencil_transpose
+                    b = chunked_pencil_transpose(b, axis_name, P, out_ax,
+                                                 K, mid)
+                else:
+                    b = self._block_transpose(b, axis_name, P, out_ax)
+                    b = mid(b)
+                    if P > 1:
+                        b = lax.all_to_all(b, axis_name, split_axis=0,
+                                           concat_axis=out_ax, tiled=True)
                 sl = [slice(None)] * b.ndim
                 sl[out_ax] = slice(0, dimsd[out_ax])   # crop tail pad
                 b = b[tuple(sl)]
@@ -434,20 +482,31 @@ class _MPIBaseFFTND(MPILinearOperator):
             if self.real:
                 b = self._scale_real(b, inverse=True)
             if 0 in axes:
-                b = self._block_transpose(b, axis_name, P, out_ax)
-                b = jnp.take(b, unpad_d, axis=0)       # exact dimsd[0]
-                if 0 in shift_after:
-                    b = jnp.fft.ifftshift(b, axes=(0,))
-                b = dft.ifft(b, n=nfft0, axis=0)
-                b = b[:dims[0]]
-                if 0 in shift_before:
-                    b = jnp.fft.fftshift(b, axes=(0,))
-                b = jnp.take(b, pad_m_src, axis=0)     # per-shard padded
-                m = pad_m_mask.reshape((-1,) + (1,) * (b.ndim - 1))
-                b = jnp.where(m, b, jnp.zeros((), dtype=b.dtype))
-                if P > 1:
-                    b = lax.all_to_all(b, axis_name, split_axis=0,
-                                       concat_axis=out_ax, tiled=True)
+                def mid(bb):
+                    bb = jnp.take(bb, unpad_d, axis=0)   # exact dimsd[0]
+                    if 0 in shift_after:
+                        bb = jnp.fft.ifftshift(bb, axes=(0,))
+                    bb = dft.ifft(bb, n=nfft0, axis=0)
+                    bb = bb[:dims[0]]
+                    if 0 in shift_before:
+                        bb = jnp.fft.fftshift(bb, axes=(0,))
+                    bb = jnp.take(bb, pad_m_src, axis=0)  # per-shard pad
+                    m = pad_m_mask.reshape((-1,) + (1,) * (bb.ndim - 1))
+                    return jnp.where(m, bb,
+                                     jnp.zeros((), dtype=bb.dtype))
+
+                K = self._pencil_chunks(b.shape[out_ax], P)
+                if K > 1:
+                    from ..parallel.collectives import \
+                        chunked_pencil_transpose
+                    b = chunked_pencil_transpose(b, axis_name, P, out_ax,
+                                                 K, mid)
+                else:
+                    b = self._block_transpose(b, axis_name, P, out_ax)
+                    b = mid(b)
+                    if P > 1:
+                        b = lax.all_to_all(b, axis_name, split_axis=0,
+                                           concat_axis=out_ax, tiled=True)
                 sl = [slice(None)] * b.ndim
                 sl[out_ax] = slice(0, dimsd[out_ax])   # crop tail pad
                 b = b[tuple(sl)]
@@ -566,26 +625,38 @@ class _MPIBaseFFTND(MPILinearOperator):
                 br = self._scale_real(br, inverse=False)
                 bi = self._scale_real(bi, inverse=False)
             if 0 in axes:
-                br, bi = self._block_transpose_planes(br, bi, axis_name,
-                                                      P, out_ax)
-                br = jnp.take(br, unpad_m, axis=0)     # exact dims[0]
-                bi = jnp.take(bi, unpad_m, axis=0)
-                if 0 in shift_before:
-                    br = jnp.fft.ifftshift(br, axes=(0,))
-                    bi = jnp.fft.ifftshift(bi, axes=(0,))
-                br, bi = dft.fft_planes(br, bi, n=nfft0, axis=0)
-                if 0 in shift_after:
-                    br = jnp.fft.fftshift(br, axes=(0,))
-                    bi = jnp.fft.fftshift(bi, axes=(0,))
-                br = jnp.take(br, pad_d_src, axis=0)   # per-shard padded
-                bi = jnp.take(bi, pad_d_src, axis=0)
-                m = pad_d_mask.reshape((-1,) + (1,) * (br.ndim - 1))
-                br = jnp.where(m, br, jnp.zeros((), dtype=br.dtype))
-                bi = jnp.where(m, bi, jnp.zeros((), dtype=bi.dtype))
-                if P > 1:
-                    br, bi = plane_all_to_all(br, bi, axis_name,
-                                              split_axis=0,
-                                              concat_axis=out_ax)
+                def mid(pr_, pi_):
+                    pr_ = jnp.take(pr_, unpad_m, axis=0)  # exact dims[0]
+                    pi_ = jnp.take(pi_, unpad_m, axis=0)
+                    if 0 in shift_before:
+                        pr_ = jnp.fft.ifftshift(pr_, axes=(0,))
+                        pi_ = jnp.fft.ifftshift(pi_, axes=(0,))
+                    pr_, pi_ = dft.fft_planes(pr_, pi_, n=nfft0, axis=0)
+                    if 0 in shift_after:
+                        pr_ = jnp.fft.fftshift(pr_, axes=(0,))
+                        pi_ = jnp.fft.fftshift(pi_, axes=(0,))
+                    pr_ = jnp.take(pr_, pad_d_src, axis=0)  # per-shard
+                    pi_ = jnp.take(pi_, pad_d_src, axis=0)
+                    m = pad_d_mask.reshape((-1,) + (1,) * (pr_.ndim - 1))
+                    pr_ = jnp.where(m, pr_, jnp.zeros((), dtype=pr_.dtype))
+                    pi_ = jnp.where(m, pi_, jnp.zeros((), dtype=pi_.dtype))
+                    return pr_, pi_
+
+                K = self._pencil_chunks(br.shape[out_ax], P)
+                if K > 1:
+                    from ..parallel.collectives import \
+                        chunked_pencil_transpose_planes
+                    br, bi = chunked_pencil_transpose_planes(
+                        br, bi, axis_name, P, out_ax, K, mid)
+                else:
+                    br, bi = self._block_transpose_planes(br, bi,
+                                                          axis_name,
+                                                          P, out_ax)
+                    br, bi = mid(br, bi)
+                    if P > 1:
+                        br, bi = plane_all_to_all(br, bi, axis_name,
+                                                  split_axis=0,
+                                                  concat_axis=out_ax)
                 sl = [slice(None)] * br.ndim
                 sl[out_ax] = slice(0, dimsd[out_ax])   # crop tail pad
                 br, bi = br[tuple(sl)], bi[tuple(sl)]
@@ -656,27 +727,40 @@ class _MPIBaseFFTND(MPILinearOperator):
             if 0 in axes:
                 if bi is None:  # axis-0 transform mixes both planes
                     bi = jnp.zeros_like(br)
-                br, bi = self._block_transpose_planes(br, bi, axis_name,
-                                                      P, out_ax)
-                br = jnp.take(br, unpad_d, axis=0)     # exact dimsd[0]
-                bi = jnp.take(bi, unpad_d, axis=0)
-                if 0 in shift_after:
-                    br = jnp.fft.ifftshift(br, axes=(0,))
-                    bi = jnp.fft.ifftshift(bi, axes=(0,))
-                br, bi = dft.ifft_planes(br, bi, n=nfft0, axis=0)
-                br, bi = br[:dims[0]], bi[:dims[0]]
-                if 0 in shift_before:
-                    br = jnp.fft.fftshift(br, axes=(0,))
-                    bi = jnp.fft.fftshift(bi, axes=(0,))
-                br = jnp.take(br, pad_m_src, axis=0)   # per-shard padded
-                bi = jnp.take(bi, pad_m_src, axis=0)
-                m = pad_m_mask.reshape((-1,) + (1,) * (br.ndim - 1))
-                br = jnp.where(m, br, jnp.zeros((), dtype=br.dtype))
-                bi = jnp.where(m, bi, jnp.zeros((), dtype=bi.dtype))
-                if P > 1:
-                    br, bi = plane_all_to_all(br, bi, axis_name,
-                                              split_axis=0,
-                                              concat_axis=out_ax)
+
+                def mid(pr_, pi_):
+                    pr_ = jnp.take(pr_, unpad_d, axis=0)  # exact dimsd[0]
+                    pi_ = jnp.take(pi_, unpad_d, axis=0)
+                    if 0 in shift_after:
+                        pr_ = jnp.fft.ifftshift(pr_, axes=(0,))
+                        pi_ = jnp.fft.ifftshift(pi_, axes=(0,))
+                    pr_, pi_ = dft.ifft_planes(pr_, pi_, n=nfft0, axis=0)
+                    pr_, pi_ = pr_[:dims[0]], pi_[:dims[0]]
+                    if 0 in shift_before:
+                        pr_ = jnp.fft.fftshift(pr_, axes=(0,))
+                        pi_ = jnp.fft.fftshift(pi_, axes=(0,))
+                    pr_ = jnp.take(pr_, pad_m_src, axis=0)  # per-shard
+                    pi_ = jnp.take(pi_, pad_m_src, axis=0)
+                    m = pad_m_mask.reshape((-1,) + (1,) * (pr_.ndim - 1))
+                    pr_ = jnp.where(m, pr_, jnp.zeros((), dtype=pr_.dtype))
+                    pi_ = jnp.where(m, pi_, jnp.zeros((), dtype=pi_.dtype))
+                    return pr_, pi_
+
+                K = self._pencil_chunks(br.shape[out_ax], P)
+                if K > 1:
+                    from ..parallel.collectives import \
+                        chunked_pencil_transpose_planes
+                    br, bi = chunked_pencil_transpose_planes(
+                        br, bi, axis_name, P, out_ax, K, mid)
+                else:
+                    br, bi = self._block_transpose_planes(br, bi,
+                                                          axis_name,
+                                                          P, out_ax)
+                    br, bi = mid(br, bi)
+                    if P > 1:
+                        br, bi = plane_all_to_all(br, bi, axis_name,
+                                                  split_axis=0,
+                                                  concat_axis=out_ax)
                 sl = [slice(None)] * br.ndim
                 sl[out_ax] = slice(0, dimsd[out_ax])   # crop tail pad
                 br, bi = br[tuple(sl)], bi[tuple(sl)]
@@ -931,12 +1015,14 @@ class MPIFFTND(_MPIBaseFFTND):
 
     def __init__(self, dims, axes=(0, 1, 2), nffts=None, sampling=1.0,
                  norm="none", real=False, ifftshift_before=False,
-                 fftshift_after=False, mesh=None, dtype="complex128"):
+                 fftshift_after=False, mesh=None, dtype="complex128",
+                 overlap=None, comm_chunks=None):
         super().__init__(dims=dims, axes=axes, nffts=nffts, sampling=sampling,
                          norm=norm, real=real,
                          ifftshift_before=ifftshift_before,
                          fftshift_after=fftshift_after, mesh=mesh,
-                         dtype=dtype)
+                         dtype=dtype, overlap=overlap,
+                         comm_chunks=comm_chunks)
 
 
 class MPIFFT2D(_MPIBaseFFTND):
@@ -944,14 +1030,16 @@ class MPIFFT2D(_MPIBaseFFTND):
 
     def __init__(self, dims, axes=(0, 1), nffts=None, sampling=1.0,
                  norm="none", real=False, ifftshift_before=False,
-                 fftshift_after=False, mesh=None, dtype="complex128"):
+                 fftshift_after=False, mesh=None, dtype="complex128",
+                 overlap=None, comm_chunks=None):
         if len(np.atleast_1d(axes)) != 2:
             raise ValueError("MPIFFT2D requires exactly two axes")
         super().__init__(dims=dims, axes=axes, nffts=nffts, sampling=sampling,
                          norm=norm, real=real,
                          ifftshift_before=ifftshift_before,
                          fftshift_after=fftshift_after, mesh=mesh,
-                         dtype=dtype)
+                         dtype=dtype, overlap=overlap,
+                         comm_chunks=comm_chunks)
 
 
 # array-less pytree registration (shift/scale factors are rebuilt from

@@ -227,16 +227,37 @@ class _MPISummaMatrixMult(_MatMulBase):
       this moves ~A-row/X-col fewer bytes per call (round-5: 6.7×
       fewer at the component-bench shape). The adjoint has always
       been stationary-A (gather Y, GEMM, psum).
+
+    ``overlap`` (``PYLOPS_MPI_TPU_OVERLAP``) switches BOTH schedules to
+    their ring-pipelined forms (round 8, arXiv 2112.09017): the bulk
+    collective along ``c`` decomposes into ``pc - 1`` double-buffered
+    ``ppermute`` hops interleaved with ``pc`` per-block GEMMs
+    (:func:`~pylops_mpi_tpu.parallel.collectives.ring_pass`), so each
+    hop's ICI transfer hides behind the resident block's MXU work:
+
+    - gather/ring: A tiles rotate along ``c``; each step GEMMs the
+      resident tile against its k-slice of the gathered X column.
+    - stat_a/ring: A still never moves — the ``psum_scatter`` becomes
+      a ring reduce-scatter whose per-chunk partial GEMM is computed
+      just-in-time at each hop.
+    - adjoint/ring: Y tiles rotate along ``c``; each step's GEMM fills
+      the owner's M-column chunk; the ``r`` psum is unchanged.
+
+    ``overlap=off`` (the default off-TPU) keeps the bulk kernels
+    bit-identical; ``on`` reorders the floating-point accumulation
+    (per-block partial sums) and matches within dtype tolerance.
     """
 
     _uses_At = False
 
     def __init__(self, A, M: int, mesh=None, dtype=None, saveAt: bool = False,
                  grid: Optional[Tuple[int, int]] = None, compute_dtype=None,
-                 schedule: str = "auto"):
+                 schedule: str = "auto", overlap=None):
+        from ..utils.deps import overlap_enabled
         base = mesh if mesh is not None else default_mesh()
         ndev = int(base.devices.size)
         self.grid = grid if grid is not None else best_grid_2d(ndev)
+        self.overlap = overlap_enabled(overlap)
         self.mesh2 = Mesh(base.devices.reshape(self.grid), ("r", "c"))
         super().__init__(A, M, mesh=base, dtype=dtype, saveAt=saveAt,
                          compute_dtype=compute_dtype)
@@ -302,6 +323,82 @@ class _MPISummaMatrixMult(_MatMulBase):
         return lax.psum_scatter(part, "c", scatter_dimension=1,
                                 tiled=True)                     # (…, Mp/pc)
 
+    # ------------------------------------------------ ring (overlap) kernels
+    def _kernel_fwd_ring(self, Ablk, Xblk):
+        # ring form of the two-sided gather schedule: X gathers along
+        # 'r' as before (the small side), but the A row-gather along
+        # 'c' becomes a pc-step ppermute ring — at each hop the GEMM on
+        # the resident A tile (against its k-slice of X) overlaps the
+        # DMA of the next neighbour tile. pc-1 permutes, pc dots,
+        # pinned by tests via utils.hlo.assert_ring_schedule.
+        from ..parallel.collectives import ring_pass
+        pc = self.grid[1]
+        Xcol = lax.all_gather(Xblk, "r", axis=0, tiled=True)  # (Kp_r, Mp/pc)
+        if self.Kp_c > self.Kp_r:
+            Xcol = jnp.pad(Xcol, ((0, self.Kp_c - self.Kp_r), (0, 0)))
+        kb = self.Kp_c // pc
+
+        def body(acc, Ares, owner, _s):
+            # owner's tile covers k-rows [owner*kb, (owner+1)*kb) of
+            # the Kp_c-padded contraction (pad rows of A/X are zeros,
+            # so padding contributes nothing — the stat_a argument)
+            Xk = lax.dynamic_slice_in_dim(Xcol, owner * kb, kb, axis=0)
+            part = self._gemm(Ares, Xk)
+            return part if acc is None else acc + part
+
+        return ring_pass(Ablk, "c", pc, body)
+
+    def _kernel_fwd_stat_a_ring(self, Ablk, Xblk):
+        # ring reduce-scatter form of stationary-A: A still never
+        # moves; the bulk psum_scatter becomes pc-1 accumulator hops
+        # along 'c', and the partial GEMM for each output M-chunk is
+        # computed just-in-time at its hop so the chunk transfer hides
+        # behind the next chunk's GEMM.
+        pc = self.grid[1]
+        Xfull = lax.all_gather(Xblk, "r", axis=0, tiled=True)
+        Xfull = lax.all_gather(Xfull, "c", axis=1, tiled=True)  # (Kp_r, Mp)
+        if self.Kp_c > self.Kp_r:
+            Xfull = jnp.pad(Xfull, ((0, self.Kp_c - self.Kp_r), (0, 0)))
+        kb = self.Kp_c // pc
+        mb = self.Mp // pc
+        c = lax.axis_index("c")
+        Xk = lax.dynamic_slice_in_dim(Xfull, c * kb, kb, axis=0)
+
+        def chunk(j):
+            Xkj = lax.dynamic_slice_in_dim(Xk, j * mb, mb, axis=1)
+            return self._gemm(Ablk, Xkj)            # (Np/pr, Mp/pc)
+
+        if pc == 1:
+            return chunk(c * 0)
+        perm = [(r, (r - 1) % pc) for r in range(pc)]
+        buf = chunk((c + 1) % pc)
+        for s in range(pc - 1):
+            rb = lax.ppermute(buf, "c", perm)
+            # the next chunk's GEMM carries no dependence on the hop
+            buf = rb + chunk((c + s + 2) % pc)
+        return buf  # fully reduced chunk c — psum_scatter's layout
+
+    def _kernel_adj_ring(self, Ablk, Yblk):
+        # ring form of the adjoint: Y tiles rotate along 'c'; each hop
+        # GEMMs the resident tile into its owner's M-column chunk
+        # (collected in rotation order, un-rotated with one roll). The
+        # 'r' psum of the K-block partials is unchanged.
+        from ..parallel.collectives import ring_pass
+        pc = self.grid[1]
+        mb = self.Mp // pc
+        c = lax.axis_index("c")
+        At = jnp.conj(Ablk).T
+        parts = []
+
+        def body(acc, Yres, _owner, _s):
+            parts.append(self._gemm(At, Yres))      # (Kp_c/pc, Mp/pc)
+            return acc
+
+        ring_pass(Yblk, "c", pc, body)
+        cat = jnp.concatenate(parts, axis=1)        # owners c, c+1, ...
+        part = jnp.roll(cat, c * mb, axis=1) if pc > 1 else cat
+        return lax.psum(part, "r")
+
     def _kernel_adj(self, Ablk, Yblk):
         # X = Aᴴ Y, contraction over N which is sharded on 'r': gather Y
         # tiles along 'c' (full M for this row-block), one local GEMM
@@ -316,8 +413,12 @@ class _MPISummaMatrixMult(_MatMulBase):
     def _matvec(self, x: DistributedArray) -> DistributedArray:
         pr, pc = self.grid
         X = _pad_to(x.array.reshape(self.K, self.M), self.Kp_r, self.Mp)
-        kernel = (self._kernel_fwd_stat_a if self.schedule == "stat_a"
-                  else self._kernel_fwd)
+        ring = self.overlap and pc > 1
+        if self.schedule == "stat_a":
+            kernel = (self._kernel_fwd_stat_a_ring if ring
+                      else self._kernel_fwd_stat_a)
+        else:
+            kernel = self._kernel_fwd_ring if ring else self._kernel_fwd
         Y = shard_map(kernel, mesh=self.mesh2,
                       in_specs=(P("r", "c"), P("r", "c")),
                       out_specs=P("r", "c"), check_vma=False)(self.Ap, X)
@@ -325,7 +426,9 @@ class _MPISummaMatrixMult(_MatMulBase):
 
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
         Y = _pad_to(x.array.reshape(self.N, self.M), self.Np, self.Mp)
-        X = shard_map(self._kernel_adj, mesh=self.mesh2,
+        kernel = (self._kernel_adj_ring
+                  if self.overlap and self.grid[1] > 1 else self._kernel_adj)
+        X = shard_map(kernel, mesh=self.mesh2,
                       in_specs=(P("r", "c"), P("r", "c")),
                       out_specs=P("c", None), check_vma=False)(self.Ap, Y)
         return self._wrap_out(X[:self.K, :self.M], x, self.K)
@@ -368,7 +471,8 @@ def MPIMatrixMult(A, M: int, saveAt: bool = False, mesh=None,
                   kind: str = "summa", dtype=None,
                   grid: Optional[Tuple[int, int]] = None,
                   compute_dtype=None,
-                  schedule: str = "auto") -> MPILinearOperator:
+                  schedule: str = "auto",
+                  overlap=None) -> MPILinearOperator:
     """Factory (ref ``MatrixMult.py:768-872``): ``kind`` in
     {"block", "summa", "auto"}.
 
@@ -380,7 +484,14 @@ def MPIMatrixMult(A, M: int, saveAt: bool = False, mesh=None,
     picks the forward communication schedule: "gather" (all-gather A
     row + X col), "stat_a" (A stays put; gather X, reduce-scatter the
     partials — wins for skinny X), or "auto" (per-device byte count
-    decides).
+    decides). ``overlap`` (summa only; ``True``/``False``/``"auto"``,
+    default the ``PYLOPS_MPI_TPU_OVERLAP`` env seam) runs the selected
+    schedule as a double-buffered ``ppermute`` ring that hides the ICI
+    transfer of each block behind the GEMM on the resident one —
+    ``off`` is bit-identical to the bulk schedules, ``on`` matches
+    within dtype tolerance (the accumulation order changes). ``block``
+    and ``auto`` kinds ignore it (forward is comm-free / the
+    partitioner owns the schedule).
     """
     if kind == "block":
         return _MPIBlockMatrixMult(A, M, mesh=mesh, dtype=dtype,
@@ -389,7 +500,7 @@ def MPIMatrixMult(A, M: int, saveAt: bool = False, mesh=None,
         return _MPISummaMatrixMult(A, M, mesh=mesh, dtype=dtype,
                                    saveAt=saveAt, grid=grid,
                                    compute_dtype=compute_dtype,
-                                   schedule=schedule)
+                                   schedule=schedule, overlap=overlap)
     if kind == "auto":
         return _MPIAutoMatrixMult(A, M, mesh=mesh, dtype=dtype,
                                   saveAt=saveAt, grid=grid,

@@ -39,14 +39,25 @@ class MPIVStack(MPILinearOperator):
     (the ``MPIBlockDiag._try_batch`` treatment; round-2 VERDICT weak
     #4). ``compute_dtype`` (e.g. ``jnp.bfloat16``) narrows the stacked
     block storage, halving HBM traffic of the memory-bound matvec.
+
+    ``overlap`` (``PYLOPS_MPI_TPU_OVERLAP``): the batched adjoint's
+    full-row reduction — the partitioner's psum of every device's
+    complete partial — becomes an explicit ring reduce-scatter whose
+    per-chunk partial GEMM is computed just-in-time at each hop
+    (P-1 ``ppermute``\\ s interleaved with P chunk GEMMs, then one
+    all-gather to restore the BROADCAST result), so each hop's ICI
+    transfer hides behind the next chunk's MXU work. ``off`` keeps the
+    einsum-then-psum path bit-identical.
     """
 
     def __init__(self, ops: Sequence[LocalOperator],
                  mask: Optional[Sequence[int]] = None,
-                 mesh=None, dtype=None, compute_dtype=None):
+                 mesh=None, dtype=None, compute_dtype=None, overlap=None):
+        from ..utils.deps import overlap_enabled
         self.ops = list(ops)
         self.mask = tuple(mask) if mask is not None else None
         self.compute_dtype = compute_dtype
+        self._overlap = overlap_enabled(overlap)
         from ..parallel.mesh import default_mesh
         self.mesh = mesh if mesh is not None else default_mesh()
         cols = {op.shape[1] for op in self.ops}
@@ -119,14 +130,75 @@ class MPIVStack(MPILinearOperator):
         y[:] = arr
         return y
 
+    def _rmatvec_batched_ring(self, x: DistributedArray) -> jax.Array:
+        """Ring reduce-scatter form of the batched adjoint reduction
+        (overlap on): each device's partial for output chunk ``j`` is a
+        restricted GEMM computed at the hop that carries ``j``'s
+        accumulator, so the ``ppermute`` of chunk ``s`` flies while
+        chunk ``s+1``'s GEMM runs — P-1 permutes interleaved with P
+        chunk GEMMs instead of one full GEMM barriered by a psum. A
+        final all-gather restores the replicated (BROADCAST) layout."""
+        import jax.numpy as _jnp
+        from jax import lax
+        from ..jaxcompat import shard_map
+        from jax.sharding import PartitionSpec as PSpec
+
+        A, adj = self._batched, self._batched_adj
+        P_ = int(self.mesh.devices.size)
+        name = self.mesh.axis_names[0]
+        nblk = A.shape[0]
+        if adj:
+            spec, out_len, conj, sl_axis, in_cols = (
+                "bmn,bn->m", A.shape[1], False, 1, A.shape[2])
+        else:
+            spec, out_len, conj, sl_axis, in_cols = (
+                "bmn,bm->n", A.shape[2], True, 2, A.shape[1])
+        cw = -(-out_len // P_)
+        Dp = P_ * cw
+        cd, dt = self.compute_dtype, self.dtype
+
+        def kernel(Ab, xb):
+            i = lax.axis_index(name)
+            if Dp != out_len:
+                pad = [(0, 0)] * 3
+                pad[sl_axis] = (0, Dp - out_len)
+                Ab = _jnp.pad(Ab, pad)
+            xl = xb.reshape(nblk // P_, in_cols)
+
+            def chunk(j):
+                As = lax.dynamic_slice_in_dim(Ab, j * cw, cw,
+                                              axis=sl_axis)
+                return einsum_narrow(spec,
+                                     _jnp.conj(As) if conj else As,
+                                     xl, cd, dt)
+
+            if P_ == 1:
+                return chunk(i * 0)
+            perm = [(r, (r - 1) % P_) for r in range(P_)]
+            buf = chunk((i + 1) % P_)
+            for s in range(P_ - 1):
+                rb = lax.ppermute(buf, name, perm)
+                # the next chunk's GEMM has no dependence on the hop
+                buf = rb + chunk((i + s + 2) % P_)
+            # device i holds the fully reduced chunk i; replicate
+            return lax.all_gather(buf, name, axis=0, tiled=True)
+
+        full = shard_map(kernel, mesh=self.mesh,
+                         in_specs=(PSpec(name), PSpec(name)),
+                         out_specs=PSpec(None), check_vma=False)(
+            A, x.array)
+        return full[:out_len]
+
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
         if self._batched is not None:
             A, adj = self._batched, self._batched_adj
             nblk = A.shape[0]
+            if self._overlap and int(self.mesh.devices.size) > 1:
+                acc = self._rmatvec_batched_ring(x)
             # per-block partials reduced over the sharded block axis —
             # the partitioner lowers the contraction to one psum, the
             # reference's sum-allreduce (ref VStack.py:135-150)
-            if adj:
+            elif adj:
                 acc = einsum_narrow("bmn,bn->m", A,
                                     x.array.reshape(nblk, A.shape[2]),
                                     self.compute_dtype, self.dtype)
@@ -176,9 +248,10 @@ class MPIHStack(MPILinearOperator):
 
     def __init__(self, ops: Sequence[LocalOperator],
                  mask: Optional[Sequence[int]] = None,
-                 mesh=None, dtype=None, compute_dtype=None):
+                 mesh=None, dtype=None, compute_dtype=None, overlap=None):
         self.vstack = MPIVStack([op.H for op in ops], mask=mask, mesh=mesh,
-                                dtype=dtype, compute_dtype=compute_dtype)
+                                dtype=dtype, compute_dtype=compute_dtype,
+                                overlap=overlap)
         self.ops = self.vstack.ops
         shape = (self.vstack.shape[1], self.vstack.shape[0])
         super().__init__(shape=shape, dtype=self.vstack.dtype)

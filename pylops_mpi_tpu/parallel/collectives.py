@@ -14,7 +14,22 @@ module holds only the hand-scheduled primitives the hot kernels consume:
   complex-free FFT mode's shard_map kernels;
 - :func:`ring_halo_extend` / :func:`cart_halo_extend` — in-kernel
   neighbour (ghost-cell) exchanges used by the stencil fast path
-  (``ops/derivatives.py``) and the N-D Cartesian halo (``ops/halo.py``).
+  (``ops/derivatives.py``) and the N-D Cartesian halo (``ops/halo.py``);
+- the **pipelined layer** (round 8, ``PYLOPS_MPI_TPU_OVERLAP``):
+  :func:`ring_pass` — the double-buffered ``ppermute`` ring behind the
+  overlapped SUMMA schedules (``ops/matrixmult.py``) and the
+  homogeneous-row stack reduction (``ops/stack.py``): P-1
+  collective-permutes interleaved with P per-block compute steps, each
+  transfer independent of the resident block's compute so the
+  latency-hiding scheduler overlaps DMA with the MXU (arXiv
+  2112.09017's decomposed-collective scheme);
+  :func:`chunked_pencil_transpose` (+ ``_planes``) — the streamed
+  pencil transpose of the distributed FFTs: K tiled ``all_to_all``
+  chunks, each chased immediately by its local transforms, so the
+  transpose streams instead of barriering (arXiv 2112.01075);
+  :func:`ring_halo_ghosts` — the halo exchange's two ghost slabs
+  WITHOUT the concatenation, so stencil kernels can issue the
+  ``ppermute``\\ s first and compute the interior while they fly.
 
 Generic allreduce/allgather wrappers existed in round 1 but had no
 production call sites (reductions lower to ``psum`` through GSPMD
@@ -28,7 +43,8 @@ call sites that need them (``DistributedArray._reduce``).
 
 from __future__ import annotations
 
-from typing import Sequence
+import logging
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 import jax
@@ -43,7 +59,14 @@ __all__ = [
     "ring_halo_extend",
     "cart_halo_extend",
     "halo_slab",
+    "ring_pass",
+    "ring_halo_ghosts",
+    "resolve_chunks",
+    "chunked_pencil_transpose",
+    "chunked_pencil_transpose_planes",
 ]
+
+_logger = logging.getLogger("pylops_mpi_tpu.collectives")
 
 
 def all_to_all_resharding(x: jax.Array, mesh: Mesh,
@@ -55,9 +78,22 @@ def all_to_all_resharding(x: jax.Array, mesh: Mesh,
 
     The implicit path (``jax.device_put`` with the new sharding) lets XLA
     pick the schedule; this explicit version pins a single
-    ``lax.all_to_all``. Requires both axes divisible by the mesh size.
+    ``lax.all_to_all``. Requires both axes divisible by the mesh size —
+    violations raise here with the axis and mesh size named, instead of
+    the shape-mismatch ``lax.all_to_all`` would throw from deep inside
+    the traced kernel.
     """
     axis_name = mesh.axis_names[0]
+    n_dev = int(mesh.devices.size)
+    for ax in dict.fromkeys((old_axis, new_axis)):
+        if x.shape[ax] % n_dev:
+            raise ValueError(
+                f"all_to_all_resharding: axis {ax} of length "
+                f"{x.shape[ax]} is not divisible by the mesh size "
+                f"{n_dev}; pad the axis to a multiple of {n_dev} first "
+                "(the pencil kernels pad-and-crop, ops/fft.py) or use "
+                "the implicit resharding (device_put with the target "
+                "sharding)")
     in_spec = [None] * x.ndim
     in_spec[old_axis] = axis_name
     out_spec = [None] * x.ndim
@@ -179,6 +215,170 @@ def halo_slab(block, axis_name: str, n_shards: int, ax: int,
         slab = lax.dynamic_update_slice_in_dim(slab, bk, front + valid,
                                                axis=ax)
     return slab
+
+
+# --------------------------------------------------------------------------
+# Pipelined layer (round 8): decomposed collectives that the
+# latency-hiding scheduler can overlap with compute. Every primitive
+# here is for use INSIDE a shard_map kernel; the bulk (non-overlapped)
+# schedules stay untouched so PYLOPS_MPI_TPU_OVERLAP=off is
+# bit-identical to the pre-round-8 programs.
+
+def ring_pass(block, axis_name: str, n_shards: int, body: Callable,
+              init=None, shift: int = 1):
+    """Double-buffered ring pipeline over one mesh axis: the resident
+    buffer starts as this shard's ``block`` and rotates ``shift``
+    positions per step, so after ``n_shards`` steps every shard has
+    seen every block — the decomposition of an all-gather-then-compute
+    into P interleaved (transfer, compute) steps (arXiv 2112.09017's
+    ring SUMMA). At step ``s`` the resident buffer is the block
+    originally owned by shard ``(i + s*shift) mod n``;
+    ``body(acc, resident, owner, s)`` folds it into the accumulator.
+
+    The next hop's ``ppermute`` is issued BEFORE the step's ``body``
+    and consumed only at the next step, so transfer ``s+1`` carries no
+    data dependence on compute ``s`` — the double buffering the TPU
+    scheduler needs to hide the DMA behind the MXU. Exactly
+    ``n_shards - 1`` collective-permutes are emitted, interleaved with
+    ``n_shards`` ``body`` calls (the ``assert_ring_schedule`` pin,
+    ``utils/hlo.py``)."""
+    n = int(n_shards)
+    i = lax.axis_index(axis_name)
+    perm = [(r, (r - shift) % n) for r in range(n)]
+    acc = init
+    resident = block
+    for s in range(n):
+        nxt = (lax.ppermute(resident, axis_name, perm)
+               if s < n - 1 else None)
+        owner = (i + s * shift) % n if n > 1 else i
+        acc = body(acc, resident, owner, s)
+        resident = nxt
+    return acc
+
+
+def ring_halo_ghosts(block, axis_name: str, n_shards: int,
+                     front: int, back: int, valid_len, ax: int = 0):
+    """The 1-D ring halo exchange's two ghost slabs, WITHOUT stitching
+    them onto the block: ``(front_ghost, back_ghost)`` — the
+    predecessor's ``front`` valid tail rows and the successor's
+    ``back`` first rows along array axis ``ax``, zero-filled at the
+    domain edges (unpaired ``ppermute`` destinations), exactly the
+    slabs :func:`halo_slab` would concatenate.
+
+    Returning the slabs unstitched is the overlap lever: the stencil
+    kernels issue these ``ppermute``\\ s FIRST, compute the interior
+    rows (which need no ghosts) while the transfers fly, and patch only
+    the ``front``/``back`` boundary rows from the received slabs
+    (``ops/derivatives.py`` overlap path). ``None`` is returned for a
+    zero-width side."""
+    n = int(n_shards)
+    gf = gb = None
+    if front:
+        start = jnp.maximum(valid_len - front, 0)
+        slab = lax.dynamic_slice_in_dim(block, start, front, axis=ax)
+        gf = lax.ppermute(slab, axis_name,
+                          [(r, r + 1) for r in range(n - 1)])
+    if back:
+        slab = lax.slice_in_dim(block, 0, back, axis=ax)
+        gb = lax.ppermute(slab, axis_name,
+                          [(r, r - 1) for r in range(1, n)])
+    return gf, gb
+
+
+def resolve_chunks(width: int, n_shards: int, chunks: int,
+                   where: str = "pencil transpose") -> int:
+    """Usable chunk count for streaming a length-``width`` axis through
+    tiled all-to-alls over ``n_shards`` devices: every chunk must carry
+    at least one row per shard, so the count caps at
+    ``width // n_shards``. A request that doesn't fit falls back (to
+    the cap, or to 1 = the bulk schedule) with a logged note instead of
+    erroring — the chunked path must degrade, never break, on small
+    axes."""
+    chunks = int(chunks)
+    if chunks <= 1 or n_shards <= 1:
+        return 1
+    cap = max(1, int(width) // int(n_shards))
+    if chunks > cap:
+        _logger.info(
+            "%s: comm_chunks=%d does not fit an axis of length %d over "
+            "%d shards; falling back to %d chunk(s)",
+            where, chunks, width, n_shards, cap)
+        return cap
+    return chunks
+
+
+def _pad_axis_to(x, axis: int, target: int):
+    if x.shape[axis] == target:
+        return x
+    padw = [(0, 0)] * x.ndim
+    padw[axis] = (0, target - x.shape[axis])
+    return jnp.pad(x, padw)
+
+
+def chunked_pencil_transpose(b, axis_name: str, n_shards: int,
+                             out_ax: int, chunks: int, mid: Callable):
+    """Streamed double pencil transpose for use *inside* a shard_map
+    kernel: split ``out_ax`` into ``chunks`` tiles (padded to a
+    ``chunks * n_shards`` multiple) and push each tile through
+    ``all_to_all(split=out_ax, concat=0) → mid(tile) →
+    all_to_all(split=0, concat=out_ax)`` independently. ``mid`` is the
+    per-tile local work — the axis-0 transform/shift/repack section of
+    the pencil FFT — which carries no cross-tile dependence, so tile
+    ``k``'s transfers overlap tile ``k±1``'s transforms instead of the
+    whole transpose barriering before any axis-0 compute (arXiv
+    2112.01075's chunked redistribution). Emits exactly ``chunks``
+    all-to-alls per transpose (the HLO pin). Returns the
+    ``out_ax``-concatenated result at the padded width — the caller
+    crops, exactly as after the bulk transpose."""
+    K = int(chunks)
+    tile = K * int(n_shards)
+    bo = -(-b.shape[out_ax] // tile)
+    b = _pad_axis_to(b, out_ax, tile * bo)
+    cw = n_shards * bo  # chunk width, divisible by the mesh size
+    outs = []
+    for k in range(K):
+        ck = lax.slice_in_dim(b, k * cw, (k + 1) * cw, axis=out_ax)
+        if n_shards > 1:
+            ck = lax.all_to_all(ck, axis_name, split_axis=out_ax,
+                                concat_axis=0, tiled=True)
+        ck = mid(ck)
+        if n_shards > 1:
+            ck = lax.all_to_all(ck, axis_name, split_axis=0,
+                                concat_axis=out_ax, tiled=True)
+        outs.append(ck)
+    return jnp.concatenate(outs, axis=out_ax) if K > 1 else outs[0]
+
+
+def chunked_pencil_transpose_planes(br, bi, axis_name: str,
+                                    n_shards: int, out_ax: int,
+                                    chunks: int, mid: Callable):
+    """Planar (re, im plane-pair) :func:`chunked_pencil_transpose`:
+    each tile's transposes are ONE stacked real all-to-all apiece
+    (:func:`plane_all_to_all`), ``mid(br_tile, bi_tile)`` returns the
+    transformed pair. Same chunking/padding/crop contract."""
+    K = int(chunks)
+    tile = K * int(n_shards)
+    bo = -(-br.shape[out_ax] // tile)
+    br = _pad_axis_to(br, out_ax, tile * bo)
+    bi = _pad_axis_to(bi, out_ax, tile * bo)
+    cw = n_shards * bo
+    outs_r, outs_i = [], []
+    for k in range(K):
+        cr = lax.slice_in_dim(br, k * cw, (k + 1) * cw, axis=out_ax)
+        ci = lax.slice_in_dim(bi, k * cw, (k + 1) * cw, axis=out_ax)
+        if n_shards > 1:
+            cr, ci = plane_all_to_all(cr, ci, axis_name,
+                                      split_axis=out_ax, concat_axis=0)
+        cr, ci = mid(cr, ci)
+        if n_shards > 1:
+            cr, ci = plane_all_to_all(cr, ci, axis_name, split_axis=0,
+                                      concat_axis=out_ax)
+        outs_r.append(cr)
+        outs_i.append(ci)
+    if K > 1:
+        return (jnp.concatenate(outs_r, axis=out_ax),
+                jnp.concatenate(outs_i, axis=out_ax))
+    return outs_r[0], outs_i[0]
 
 
 def ring_halo_extend(block, axis_name: str, n_shards: int,

@@ -22,6 +22,23 @@ backend — XLA collectives — so the seam carries different switches:
   available explicitly through ``compute_dtype=bfloat16`` (bf16 inputs
   are unaffected by the precision flag). Set to ``default`` to restore
   JAX's backend default.
+- ``PYLOPS_MPI_TPU_OVERLAP``: ``auto`` (default) | ``on`` | ``off`` —
+  the pipelined-collectives seam (round 8). ``on`` switches the
+  comm-heavy operator families to overlapped schedules: ring SUMMA
+  (double-buffered ``ppermute`` + per-step GEMM instead of bulk
+  gather/psum), chunked pencil transposes (K tiled ``all_to_all``\\ s
+  interleaved with the per-chunk local transforms), and
+  interior/boundary-split halo stencils (ghost ``ppermute``\\ s in
+  flight while the interior computes). ``off`` keeps the bulk
+  schedules bit-identical to pre-round-8 results; ``auto`` enables the
+  overlap only on real TPU backends, where it hides ICI transfer
+  behind MXU compute — on the CPU simulation the chunked schedules
+  only add dispatches. Per-operator ``overlap=`` kwargs override the
+  env.
+- ``PYLOPS_MPI_TPU_COMM_CHUNKS``: default chunk count (4) for the
+  streamed pencil transposes when the overlap is enabled; per-operator
+  ``comm_chunks=`` wins. Chunk counts that don't fit the axis fall
+  back (logged) instead of erroring.
 """
 
 from __future__ import annotations
@@ -29,7 +46,8 @@ from __future__ import annotations
 import os
 
 __all__ = ["jax_enabled", "platform_override", "x64_enabled",
-           "explicit_stencil_enabled", "apply_environment"]
+           "explicit_stencil_enabled", "apply_environment",
+           "overlap_mode", "overlap_enabled", "comm_chunks_default"]
 
 jax_enabled = True  # the only engine; mirrors deps.nccl_enabled's role
 
@@ -48,6 +66,64 @@ def explicit_stencil_enabled() -> bool:
 
 def x64_enabled() -> bool:
     return os.environ.get("PYLOPS_MPI_TPU_X64", "0") == "1"
+
+
+_warned_overlap = False
+
+
+def overlap_mode() -> str:
+    """``PYLOPS_MPI_TPU_OVERLAP`` resolved to ``auto``/``on``/``off``
+    (unknown values fall back to ``auto`` with a one-time warning — a
+    typo in a CI matrix must not silently flip schedules)."""
+    global _warned_overlap
+    m = os.environ.get("PYLOPS_MPI_TPU_OVERLAP", "auto").strip().lower()
+    if m in ("", "none", "default"):
+        m = "auto"
+    if m not in ("auto", "on", "off"):
+        if not _warned_overlap:
+            import warnings
+            warnings.warn(
+                f"PYLOPS_MPI_TPU_OVERLAP={m!r} is not one of "
+                "['auto', 'on', 'off']; using 'auto'", stacklevel=2)
+            _warned_overlap = True
+        m = "auto"
+    return m
+
+
+def overlap_enabled(user=None) -> bool:
+    """Resolve the pipelined-collectives tri-state to a bool. ``user``
+    is a per-operator ``overlap=`` kwarg (``True``/``False``/
+    ``"on"``/``"off"``/``"auto"``; ``None`` defers to the env).
+    ``auto`` enables overlap only on real TPU backends: the ring /
+    chunked schedules exist to hide ICI transfer behind compute, and on
+    the CPU simulation they only add dispatch overhead while ``off``
+    stays bit-identical to the bulk results."""
+    if isinstance(user, bool):
+        return user
+    if user is None:
+        mode = overlap_mode()
+    else:
+        mode = str(user).strip().lower()
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"overlap={user!r}: expected 'auto', 'on', 'off', "
+                "True or False")
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    import jax
+    return jax.default_backend() == "tpu"
+
+
+def comm_chunks_default() -> int:
+    """Default chunk count for the streamed pencil transposes
+    (``PYLOPS_MPI_TPU_COMM_CHUNKS``, default 4; floored at 1)."""
+    try:
+        v = int(os.environ.get("PYLOPS_MPI_TPU_COMM_CHUNKS", "4"))
+    except ValueError:
+        v = 4
+    return max(1, v)
 
 
 def matmul_precision():

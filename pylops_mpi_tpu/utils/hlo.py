@@ -29,7 +29,8 @@ import jax
 __all__ = ["collective_report", "assert_no_full_gather",
            "parse_hlo_collectives", "complex_dtype_lines",
            "assert_complex_free", "compiled_hlo", "count_ops",
-           "assert_max_converts", "donation_report", "assert_donation"]
+           "assert_max_converts", "donation_report", "assert_donation",
+           "count_collectives", "assert_ring_schedule"]
 
 # HLO opcode -> canonical name; bytes counted from the result shape
 _COLLECTIVE_OPS = ("all-gather", "all-reduce", "all-to-all",
@@ -283,6 +284,112 @@ def assert_donation(fn, *args, min_aliased: int = 1, **kwargs) -> Dict:
             "parameter: the donated buffer is being defensively copied "
             "instead of aliased in place")
     return rep
+
+
+def count_collectives(fn, *args, kind: Optional[str] = None, **kwargs):
+    """Compile ``fn(*args, **kwargs)`` and return the per-kind
+    collective instruction counts (``{"all-to-all": 2, ...}``), or a
+    single int when ``kind`` is given (0 when absent). The counting
+    handle for the pipelined-schedule pins: chunked pencil transpose =
+    K all-to-alls per transpose, bulk paths' op counts unchanged."""
+    rep = collective_report(fn, *args, **kwargs)
+    counts = {k: v["count"] for k, v in rep.items()}
+    if kind is not None:
+        return counts.get(kind, 0)
+    return counts
+
+
+_DEF_RE = re.compile(r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=")
+_USE_RE = re.compile(r"%([\w.\-]+)")
+
+
+def _defuse_graph(hlo: str):
+    """``result name -> operand names`` over the whole module (text
+    level; computation calls appear as ``calls=%name`` operands, which
+    conservatively widens reachability — fine for chain checks)."""
+    graph = {}
+    for line in hlo.splitlines():
+        m = _DEF_RE.match(line)
+        if m is None:
+            continue
+        rhs = line.split("=", 1)[1]
+        graph[m.group(1)] = [u for u in _USE_RE.findall(rhs)]
+    return graph
+
+
+def _op_results(hlo: str, opcode: str) -> list:
+    """Result names of every ``opcode`` (or async ``opcode-start``)
+    instruction, in text order."""
+    pat = re.compile(r"\b" + re.escape(opcode) + r"(-start)?(?:\.\d+)?\(")
+    out = []
+    for line in hlo.splitlines():
+        m = _DEF_RE.match(line)
+        if m is None or "=" not in line:
+            continue
+        rhs = line.split("=", 1)[1]
+        pm = pat.search(rhs)
+        if pm is not None and not (pm.start() > 0
+                                   and rhs[pm.start() - 1] == "%"):
+            out.append(m.group(1))
+    return out
+
+
+def assert_ring_schedule(fn, *args, steps: int, dots: Optional[int] = None,
+                         check_chain: bool = True, **kwargs):
+    """Compile and assert the program lowered as a double-buffered ring
+    (``parallel.collectives.ring_pass``):
+
+    - exactly ``steps`` collective-permutes (sync or async ``-start``),
+      i.e. P-1 hops — a bulk all-gather-then-GEMM shows 0 permutes and
+      is the regression this pin exists to catch;
+    - when ``dots`` is given, at least that many ``dot`` instructions
+      (one local GEMM per ring step);
+    - when ``check_chain``, the permutes form a DEPENDENCY CHAIN (hop
+      ``s+1`` transitively consumes hop ``s``'s result) — the
+      pipelined-ring signature, as opposed to ``steps`` independent
+      one-shot permutes all issued against the same buffer. Checked on
+      the def-use graph, not instruction print order, which the CPU
+      backend shuffles.
+
+    Returns ``(n_permutes, n_dots)``."""
+    hlo = compiled_hlo(fn, *args, **kwargs)
+    perms = _op_results(hlo, "collective-permute")
+    n_dots = len(_op_results(hlo, "dot"))
+    if len(perms) != steps:
+        raise AssertionError(
+            f"expected a ring of exactly {steps} collective-permute "
+            f"step(s), found {len(perms)} — the schedule did not lower "
+            "as a ring (bulk gather, or a fused/eliminated chain)")
+    if dots is not None and n_dots < dots:
+        raise AssertionError(
+            f"expected >= {dots} dot op(s) (one local GEMM per ring "
+            f"step), found {n_dots}")
+    if check_chain and steps >= 2:
+        graph = _defuse_graph(hlo)
+        pset = set(perms)
+
+        def upstream_perms(name, seen=None):
+            seen = set() if seen is None else seen
+            hits = set()
+            stack = list(graph.get(name, ()))
+            while stack:
+                u = stack.pop()
+                if u in seen:
+                    continue
+                seen.add(u)
+                if u in pset:
+                    hits.add(u)
+                stack.extend(graph.get(u, ()))
+            return hits
+
+        depths = sorted(len(upstream_perms(p)) for p in perms)
+        if depths != list(range(steps)):
+            raise AssertionError(
+                f"collective-permutes do not form a dependency chain "
+                f"(upstream-permute counts {depths}, expected "
+                f"{list(range(steps))}): the hops were issued in "
+                "parallel, not pipelined as a ring")
+    return len(perms), n_dots
 
 
 def assert_no_full_gather(fn, *args, max_fraction: float = 0.5, **kwargs):

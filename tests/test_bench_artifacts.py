@@ -351,6 +351,40 @@ def test_bisect_cpu_children_still_not_merged(bench, tmp_path):
     assert "tpu_bisect" not in out
 
 
+def test_overlap_stage_merged_and_compacted(bench, tmp_path):
+    """The harvest ladder's overlap stage (round 8 bulk-vs-pipelined
+    schedule races) merges only as hardware evidence and surfaces the
+    per-row ratios in the compact stdout line."""
+    root = str(tmp_path)
+    rows = [{"bench": "summa_overlap", "value": 1.4,
+             "pipelined_vs_bulk": 1.4, "ring_steps": 3,
+             "ici_bytes_per_step": 524288, "schedule": "gather"},
+            {"bench": "pencil_a2a_chunked", "value": 1.1,
+             "pipelined_vs_bulk": 1.1, "comm_chunks": 4,
+             "a2a_count": 8, "ici_bytes_per_chunk": 131072}]
+    _write(root, cache={
+        "overlap": {"result": {"kind": "overlap_stage",
+                               "platform": "tpu", "rows": rows},
+                    "ts": "t", "code_rev": "abc"},
+    })
+    out = bench._merge_tpu_cache({"platform": "tpu", "value": 1.0},
+                                 root=root)
+    assert [r["bench"] for r in out["tpu_overlap"]["rows"]] == \
+        ["summa_overlap", "pencil_a2a_chunked"]
+    line = bench._compact_line(out)
+    assert line["overlap"] == {"summa_overlap": 1.4,
+                               "pencil_a2a_chunked": 1.1}
+    # a CPU rehearsal of the same stage must NOT merge
+    _write(root, cache={
+        "overlap": {"result": {"kind": "overlap_stage",
+                               "platform": "cpu", "rows": rows},
+                    "ts": "t", "rehearse": True, "code_rev": "abc"},
+    })
+    out2 = bench._merge_tpu_cache({"platform": "tpu", "value": 1.0},
+                                  root=root)
+    assert "tpu_overlap" not in out2
+
+
 def test_fft_planar_stage_merged_and_compacted(bench, tmp_path):
     """The harvest ladder's fft_planar stage (the planar-FFT hardware
     verdict) merges under the same rules as bisect and surfaces an

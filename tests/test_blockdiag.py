@@ -115,12 +115,17 @@ def test_hstack(rng):
     np.testing.assert_allclose(yd.asarray(), dense @ x, rtol=1e-10)
 
 
-def test_vstack_batched_engages_and_matches_loop(rng):
+@pytest.mark.parametrize("overlap", [
+    "off", pytest.param("on", marks=pytest.mark.slow)])
+def test_vstack_batched_engages_and_matches_loop(rng, overlap):
     """Round-2 VERDICT weak #4: homogeneous MatrixMult rows must
     collapse into one batched GEMM (trace O(1)); heterogeneous rows
-    keep the per-op chain with identical values."""
+    keep the per-op chain with identical values. With overlap on the
+    batched adjoint reduction runs as the ring reduce-scatter and must
+    match the same oracle."""
     mats = [rng.standard_normal((4, 10)) for _ in range(2 * P)]
-    Op = MPIVStack([MatrixMult(m, dtype=np.float64) for m in mats])
+    Op = MPIVStack([MatrixMult(m, dtype=np.float64) for m in mats],
+                   overlap=overlap)
     assert Op._batched is not None and Op._batched_adj is False
     dense = np.vstack(mats)
     x = rng.standard_normal(10)

@@ -28,20 +28,27 @@ def test_fftnd_complex_forward(rng, dims, axes):
     # runs keep the matmul oracle only (VERDICT next #7)
     pytest.param("planar", marks=pytest.mark.slow),
 ])
+@pytest.mark.parametrize("overlap", [
+    "off",
+    # chunked rows ride the test-overlap CI leg; slow-marked for the
+    # tier-1 wall budget (same treatment as the planar engine param)
+    pytest.param("on", marks=pytest.mark.slow),
+])
 @pytest.mark.parametrize("real", [False, True])
 def test_fftnd_matmul_engine_operator_oracle(rng, monkeypatch, real,
-                                             engine):
+                                             engine, overlap):
     """The distributed operators must be engine-agnostic: forward,
     adjoint and the dot test all through BOTH GEMM DFT engines —
     planar is what auto picks on FFT-less TPU runtimes (round-5
     hardware finding: no complex lowering at all), so the sharded
     pencil path must be CI-validated under it, not just under the
     complex matmul engine. Complex and rfft paths, ragged sharded
-    axis."""
+    axis, bulk and chunk-streamed (overlap on) pencil transposes."""
     monkeypatch.setenv("PYLOPS_MPI_TPU_FFT_MODE", engine)
     dims = (18, 10)  # 18 % 8 != 0: ragged over the 8-device mesh
     dtype = np.float64 if real else np.complex128
-    Fop = MPIFFTND(dims, axes=(0, 1), real=real, dtype=dtype)
+    Fop = MPIFFTND(dims, axes=(0, 1), real=real, dtype=dtype,
+                   overlap=overlap, comm_chunks=2)
     x = rng.standard_normal(dims)
     if not real:
         x = x + 1j * rng.standard_normal(dims)
@@ -501,13 +508,18 @@ def test_planar_real_halfspectrum_a2a_bytes(rng, monkeypatch):
     n = int(np.prod(dims))
     dft.set_fft_mode("planar")
     try:
-        Rop = MPIFFTND(dims, axes=(0, 1), real=True, dtype=np.float32)
+        # overlap="off" on BOTH: this is a payload-size pin (two f32
+        # planes vs full-spectrum c64), and the chunked schedules pad
+        # to chunk multiples, which would skew the byte ratio
+        Rop = MPIFFTND(dims, axes=(0, 1), real=True, dtype=np.float32,
+                       overlap="off")
         xr = DistributedArray.to_dist(
             rng.standard_normal(n).astype(np.float32),
             local_shapes=Rop.model_local_shapes)
         rep_p = collective_report(lambda a: Rop.matvec_planes(a)[0], xr)
         dft.set_fft_mode("matmul")
-        Cop = MPIFFTND(dims, axes=(0, 1), dtype=np.complex64)
+        Cop = MPIFFTND(dims, axes=(0, 1), dtype=np.complex64,
+                       overlap="off")
         xc = DistributedArray.to_dist(
             (rng.standard_normal(n)
              + 1j * rng.standard_normal(n)).astype(np.complex64),

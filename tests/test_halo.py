@@ -49,12 +49,15 @@ def test_halo_block_split():
     assert sl == (slice(5, 10), slice(3, 6))
 
 
+@pytest.mark.parametrize("overlap", [
+    "off", pytest.param("on", marks=pytest.mark.slow)])
 @pytest.mark.parametrize("halo", [1, 2])
-def test_halo_1d_scalar(rng, halo):
-    """Scalar halo is trimmed at grid boundaries (ref Halo.py:204-210)."""
+def test_halo_1d_scalar(rng, halo, overlap):
+    """Scalar halo is trimmed at grid boundaries (ref Halo.py:204-210);
+    the overlap (interior-select) repack must match exactly."""
     n = 3 * P
     x = rng.standard_normal(n)
-    Hop = MPIHalo(dims=n, halo=halo, dtype=np.float64)
+    Hop = MPIHalo(dims=n, halo=halo, dtype=np.float64, overlap=overlap)
     dx = DistributedArray.to_dist(x)  # even split == block split for 1-D
     y = Hop.matvec(dx)
     # oracle: each block extended with neighbour rows, one-sided at edges
@@ -81,14 +84,18 @@ def test_halo_1d_tuple_zero_boundary(rng):
                                np.concatenate([x[n - 3:], [0]]))
 
 
-def test_halo_2d_grid(rng):
+@pytest.mark.parametrize("overlap", [
+    "off", pytest.param("on", marks=pytest.mark.slow)])
+def test_halo_2d_grid(rng, overlap):
     """2-D Cartesian grid with diagonal corners (the relay pattern of
-    ref Halo.py:320-360)."""
+    ref Halo.py:320-360); overlap on must reproduce the corner relay
+    exactly (interior from the local block, shells from the relay)."""
     grid = _grid2(P)
     dims = (4 * grid[0], 2 * grid[1])
     x = rng.standard_normal(dims)
     flat, sizes = _block_flat(x, grid)
-    Hop = MPIHalo(dims=dims, halo=1, proc_grid_shape=grid, dtype=np.float64)
+    Hop = MPIHalo(dims=dims, halo=1, proc_grid_shape=grid, dtype=np.float64,
+                  overlap=overlap)
     dx = DistributedArray.to_dist(flat, local_shapes=sizes)
     y = Hop.matvec(dx)
     locs = y.local_arrays()

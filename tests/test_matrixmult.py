@@ -101,15 +101,23 @@ def test_summa_rectangular_grids(rng, grid, N, K, M):
     dottest(Op, dx, dy)
 
 
+@pytest.mark.parametrize("overlap", [
+    "off",
+    # the ring rows ride the test-overlap CI leg (full file, no -m
+    # filter) — slow-marked here for the tier-1 wall budget, the same
+    # treatment as the planar FFT params (VERDICT next #7)
+    pytest.param("on", marks=pytest.mark.slow),
+])
 @pytest.mark.parametrize("schedule", ["gather", "stat_a"])
 @pytest.mark.parametrize("N,K,M", [(24, 16, 8), (13, 11, 7)])
-def test_summa_schedules_match_oracle(rng, schedule, N, K, M):
+def test_summa_schedules_match_oracle(rng, schedule, N, K, M, overlap):
     """Both forward communication schedules (gather-A-row and
     stationary-A reduce-scatter) must agree with the dense oracle and
-    pass the dot test, including ragged tile shapes."""
+    pass the dot test, including ragged tile shapes — bulk AND
+    ring-pipelined (overlap on) forms."""
     A, X, Y = _make_AXY(rng, N, K, M, np.float64)
     Op = MPIMatrixMult(A, M, kind="summa", dtype=np.float64,
-                       schedule=schedule)
+                       schedule=schedule, overlap=overlap)
     assert Op.schedule == schedule
     dx = DistributedArray.to_dist(X.ravel())
     dy = DistributedArray.to_dist(Y.ravel())
