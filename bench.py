@@ -26,7 +26,19 @@ Extra keys beyond the required four:
 - ``components``: the per-config results of
   ``benchmarks/bench_components.py`` (all 5 BASELINE.md driver
   configs), each individually try/except-guarded.
+- ``roofline`` (and per-mode ``f32.roofline`` / ``bf16.roofline``):
+  predicted-vs-measured placement from the diagnostics cost model
+  (``pylops_mpi_tpu/diagnostics/costmodel.py``) — per-iteration
+  FLOPs/HBM bytes against the per-chip peaks, with the binding
+  resource named (``bound``). On the CPU sim the peak is an assumed
+  stream bandwidth, labeled ``peak_source=assumed_cpu_stream``.
 - ``platform`` / ``degraded`` / ``tpu_error``: provenance.
+
+Stage budgets (selfcheck/component subprocess timeouts) come from the
+central table in ``pylops_mpi_tpu/diagnostics/profiler.py`` (env
+overrides unchanged); with ``PYLOPS_MPI_TPU_TRACE`` on, the child also
+writes a Chrome-trace JSONL (``bench_trace.jsonl``) next to
+``bench_detail.json``.
 """
 
 import json
@@ -38,6 +50,39 @@ import time
 import numpy as np
 
 _CHILD_FLAG = "--child"
+
+
+def _profiler_mod():
+    """The diagnostics profiler module (central stage-budget table +
+    deadline runner), loaded BY FILE PATH so the jax-free parent/
+    supervisor processes never import the package (and jax). The
+    module is standalone-loadable by design (stdlib-only imports).
+    Returns None when unavailable — callers fall back to their
+    historical literals."""
+    try:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "pylops_mpi_tpu", "diagnostics", "profiler.py")
+        spec = importlib.util.spec_from_file_location(
+            "_pmt_diag_profiler", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None
+
+
+def _stage_budget(stage: str, default: int, rehearse: bool = False) -> int:
+    """Wall budget for a harvest/bench stage from the ONE central
+    table (pylops_mpi_tpu/diagnostics/profiler.py), env overrides
+    included; ``default`` only covers a missing/broken table."""
+    mod = _profiler_mod()
+    if mod is None:
+        return default
+    try:
+        return mod.stage_budget(stage, rehearse=rehearse)
+    except Exception:
+        return default
 
 # dense matmul peak per chip, TFLOP/s (bf16 inputs, f32 accumulation on
 # the MXU) — public spec-sheet numbers; most-specific key checked first
@@ -271,6 +316,13 @@ def child_main():
     here = os.path.dirname(os.path.abspath(__file__))
     sys.path.insert(0, here)
 
+    # tracing on (PYLOPS_MPI_TPU_TRACE=spans|full) with no explicit
+    # sink: land the Chrome-trace JSONL next to bench_detail.json so
+    # the run always leaves an openable artifact
+    if os.environ.get("PYLOPS_MPI_TPU_TRACE", "off") not in ("", "off"):
+        os.environ.setdefault("PYLOPS_MPI_TPU_TRACE_FILE",
+                              os.path.join(here, "bench_trace.jsonl"))
+
     def _progress(msg):
         # stderr markers: when the supervising daemon kills this child on
         # timeout, its stderr tail shows the stage reached (round 3: a
@@ -298,8 +350,7 @@ def child_main():
             here_b = os.path.join(here, "benchmarks", "tpu_selfcheck.py")
             selfcheck, sc_err = _run_json_cmd(
                 [sys.executable, here_b], dict(os.environ),
-                timeout=int(os.environ.get(
-                    "BENCH_SELFCHECK_TIMEOUT", "600")), cwd=here)
+                timeout=_stage_budget("bench_selfcheck", 600), cwd=here)
             if selfcheck is None:
                 raise RuntimeError(sc_err or "selfcheck subprocess died")
             if selfcheck.get("platform") != "tpu":
@@ -450,8 +501,7 @@ def child_main():
             components = run_components(quick=not on_tpu)
             components = retry_failed_isolated(
                 components, quick=not on_tpu,
-                timeout=int(os.environ.get(
-                    "BENCH_COMPONENT_TIMEOUT", "150")))
+                timeout=_stage_budget("component", 150))
         except Exception as e:  # components must never kill the headline
             components = [{"bench": "components", "error": repr(e)[:300]}]
         # release fused-solver cache entries (compiled executables +
@@ -628,6 +678,53 @@ def child_main():
     peak_bf16 = _peak_flops_per_chip(jax.devices()[0], "bf16")
     peak_f32 = _peak_flops_per_chip(jax.devices()[0], "f32_highest")
     peak_hbm = _peak_hbm_gbps(jax.devices()[0]) if on_tpu else None
+
+    def _roofline_row(row_ips, itemsize, mode_str):
+        """Predicted-vs-measured roofline columns for one bench row
+        (diagnostics/costmodel.py): the cost model's per-iteration
+        FLOPs/HBM bytes against the per-chip peaks. On TPU the peaks
+        are spec-sheet; on the CPU sim an assumed stream bandwidth
+        (BENCH_CPU_GBPS, default 30 GB/s/socket, carved across the
+        virtual devices) keeps the columns present and clearly
+        labeled — the point of the row is attribution, not a
+        benchmark of the laptop."""
+        try:
+            from pylops_mpi_tpu.diagnostics import costmodel
+        except Exception:
+            return None
+        try:
+            nd = max(n_dev, 1)
+            sweeps = 1 if "fused-normal" in mode_str else 2
+            cost = costmodel.OpCost(
+                flops=4.0 * nblock * nblock * nblk / nd,
+                hbm_bytes=sweeps * nblock * nblock * nblk * itemsize / nd,
+                ici_bytes=0.0, notes=("cgls.per_iteration",))
+            if on_tpu:
+                peaks = costmodel.device_peaks(
+                    jax.devices()[0],
+                    mode="bf16" if itemsize == 2 else "f32_highest")
+                src = "tpu_spec"
+            else:
+                try:
+                    socket_gbps = float(os.environ.get(
+                        "BENCH_CPU_GBPS", "30"))
+                except ValueError:
+                    socket_gbps = 30.0
+                peaks = {"flops": None, "hbm_gbps": socket_gbps / nd,
+                         "ici_gbps": None}
+                src = "assumed_cpu_stream"
+            rl = costmodel.roofline(cost, peaks, n_dev=nd)
+            out = {"bound": rl["bound"], "peak_source": src,
+                   "flops_per_iter_dev": cost.flops,
+                   "hbm_bytes_per_iter_dev": cost.hbm_bytes}
+            if rl["predicted_s"]:
+                pred_ips = 1.0 / rl["predicted_s"]
+                out["predicted_iters_per_sec"] = round(pred_ips, 2)
+                out["measured_iters_per_sec"] = round(row_ips, 2)
+                out["measured_vs_predicted"] = _sig3(row_ips / pred_ips)
+            return out
+        except Exception as e:  # roofline must never kill the headline
+            return {"error": repr(e)[:200]}
     f32_mfu = (_sig3(f32_gflops * 1e9 / (peak_f32 * n_dev))
                if peak_f32 else None)
     b_mfu = (_sig3(b_gflops * 1e9 / (peak_bf16 * n_dev))
@@ -655,6 +752,13 @@ def child_main():
         return {"hbm_pct": None}  # unknown chip: no roofline claimed
     if bf16_res is not None:
         bf16_res.update(_hbm_fields(b_gbps, 2))
+        rr = _roofline_row(b_ips, 2, b_mode)
+        if rr:
+            bf16_res["roofline"] = rr
+    f32_roofline = _roofline_row(f32_ips, 4, f32_mode)
+    head_roofline = (bf16_res.get("roofline")
+                     if (primary_bf16 and bf16_res is not None)
+                     else f32_roofline)
 
     result = {
         "metric": f"CGLS iters/sec (BlockDiag MatrixMult, {nblk}x{nblock}^2,"
@@ -672,6 +776,7 @@ def child_main():
         "platform": platform,
         "n_devices": n_dev,
         "gflops": round(gflops, 1),
+        **({"roofline": head_roofline} if head_roofline else {}),
         "f32": {"iters_per_sec": round(f32_ips, 2),
                 "gflops": round(f32_gflops, 1),
                 "hbm_gbps": round(f32_gbps, 1),
@@ -680,6 +785,7 @@ def child_main():
                 "rel_err": f"{f32_err:.1e}",
                 "mfu": f32_mfu,  # vs the f32-`highest` peak (bf16/6)
                 "mode": f32_mode,
+                **({"roofline": f32_roofline} if f32_roofline else {}),
                 **({"race": f32_race} if f32_race else {}),
                 **({"spread_pct": f32_spread}
                    if f32_spread is not None else {})},
@@ -710,7 +816,7 @@ def child_main():
             from benchmarks.bench_components import (_run_one_isolated,
                                                      _BENCHES,
                                                      run_components)
-            t_comp = int(os.environ.get("BENCH_COMPONENT_TIMEOUT", "150"))
+            t_comp = _stage_budget("component", 150)
             isolation_dead = False
             for name, _fn in _BENCHES:
                 if not isolation_dead:
@@ -907,7 +1013,8 @@ def _merge_tpu_cache(result, root=None):
                 cpu_live = {k: result.get(k) for k in
                             ("metric", "value", "vs_baseline", "platform",
                              "degraded", "tpu_error", "components",
-                             "cpu_breakdown", "flagship_1dev_cpu")
+                             "cpu_breakdown", "flagship_1dev_cpu",
+                             "roofline", "f32", "bf16")
                             if k in result}
                 result = dict(r)
                 result["cached"] = True
@@ -1131,6 +1238,12 @@ def _compact_line(result):
                            if result["bf16"].get(k) is not None}
     if result.get("bf16_race"):
         compact["bf16_race"] = result["bf16_race"]
+    rl = result.get("roofline") or {}
+    if rl and not rl.get("error"):
+        compact["roofline"] = {
+            k: rl.get(k) for k in
+            ("bound", "predicted_iters_per_sec", "measured_vs_predicted",
+             "peak_source") if rl.get(k) is not None}
     if result.get("flagship_1dev_cpu"):
         f1 = result["flagship_1dev_cpu"]
         compact["flagship_1dev_cpu"] = (
@@ -1177,9 +1290,9 @@ def _compact_line(result):
                             "statuses": probe.get("statuses"),
                             "last_ts": probe.get("last_ts")}
     # hard ≤2KB guarantee: shed optional detail, most-expendable first
-    for victim in ("probe", "components", "bf16_race", "bf16", "f32",
-                   "flagship_1dev_cpu", "tpu_breakdown", "overlap",
-                   "fft_planar", "selfcheck"):
+    for victim in ("probe", "roofline", "components", "bf16_race",
+                   "bf16", "f32", "flagship_1dev_cpu", "tpu_breakdown",
+                   "overlap", "fft_planar", "selfcheck"):
         if len(json.dumps(compact)) <= 2000:
             break
         compact.pop(victim, None)

@@ -12,8 +12,13 @@ its configured budget, the persistent XLA compile cache hits across
 the bench child processes, a killed full run still salvages its
 headline, a breakdown child killed MID-STAGE still banks every
 section completed before the kill (the per-section partial-line
-banking, proven here by an injected kill), and rehearsal artifacts can
-never be promoted as TPU evidence.
+banking, proven here by an injected kill), the diagnostics
+``DeadlineRunner`` (round 9) kills an over-budget stage AT its
+budget while banking the partial artifact and keeping the window
+usable — and skips stages an exhausted window cannot fit — and
+rehearsal artifacts can never be promoted as TPU evidence. Budgets
+come from the ONE central table
+(``pylops_mpi_tpu/diagnostics/profiler.py``).
 
 Run: ``python benchmarks/rehearse_ladder.py [--fast]``
 (``--fast`` shrinks the full rung to N=2048 so the whole rehearsal
@@ -38,11 +43,30 @@ _ROOT = os.path.dirname(_HERE)
 sys.path.insert(0, _ROOT)
 sys.path.insert(0, _HERE)  # for tpu_probe_loop.rehearse_env
 
-BUDGETS = {  # seconds; the real window budgets this rehearsal enforces
+# the budgets this rehearsal enforces come from the ONE central table
+# (pylops_mpi_tpu/diagnostics/profiler.py, "rehearse" column — the
+# literals that used to be duplicated inline here); the fallback only
+# covers a missing/broken table
+_FALLBACK_BUDGETS = {
     "selfcheck": 600, "flagship_small": 600, "fft_planar": 600,
     "overlap": 600, "breakdown": 700, "diag": 700, "flagship_mid": 1200,
     "flagship_full": 2400,
 }
+
+
+def _load_budgets() -> dict:
+    import bench
+    prof = bench._profiler_mod()
+    if prof is None:
+        return dict(_FALLBACK_BUDGETS)
+    try:
+        return {k: prof.stage_budget(k, rehearse=True)
+                for k in _FALLBACK_BUDGETS}
+    except Exception:
+        return dict(_FALLBACK_BUDGETS)
+
+
+BUDGETS = _load_budgets()
 
 
 def _cache_files() -> int:
@@ -81,7 +105,14 @@ def main() -> None:
     stage_env = {f"PROBE_{k.replace('flagship_', '').upper()}_TIMEOUT":
                  str(v) for k, v in BUDGETS.items()}
     if args.fast:
-        stage_env["BENCH_NBLOCK_PYLOPS_MPI_TPU"] = "2048"
+        # REHEARSE_FAST_NBLOCK: shrink the full rung further on slow
+        # hosts (a 1-core driver container can't rehearse N=2048 in
+        # any reasonable wall time; the protocol being proven —
+        # budgets, banking, salvage — is size-independent)
+        stage_env["BENCH_NBLOCK_PYLOPS_MPI_TPU"] = os.environ.get(
+            "REHEARSE_FAST_NBLOCK", "2048")
+        stage_env["PROBE_MID_NBLOCK"] = os.environ.get(
+            "REHEARSE_FAST_NBLOCK", "2048")
         stage_env["BENCH_REPS_PYLOPS_MPI_TPU"] = "3"
 
     # ---- pass 1: the full ladder under budget ----
@@ -110,6 +141,13 @@ def main() -> None:
         ladder_ok &= ok
     art["stages"] = stages
     art["ladder_ok"] = ladder_ok
+    # round 9: every harvested stage must carry the DeadlineRunner's
+    # record (budget + effective timeout) — the proof the ladder now
+    # runs through the central budget table
+    art["deadline_records_ok"] = all(
+        isinstance((cache.get(n) or {}).get("deadline"), dict)
+        and (cache[n]["deadline"].get("budget_s") == b)
+        for n, b in BUDGETS.items() if n in cache)
     art["compile_cache_files_added"] = _cache_files() - cf0
 
     # ---- pass 2: warm re-run of the small rung → compile-cache proof
@@ -190,6 +228,46 @@ def main() -> None:
                    and r4.get("partial") and "dispatch_ms" in banked),
         **({"error": e4} if e4 else {})}
 
+    # ---- pass 3c: the deadline runner itself — a stage that exceeds
+    # its budget must be killed AT budget, bank its partial artifact,
+    # and leave the runner able to run the next stage (the window is
+    # yielded, not eaten); a runner whose window is exhausted must
+    # SKIP instead of starting a doomed stage ----
+    prof = bench._profiler_mod()
+    dr = {"ok": False, "note": "profiler module unavailable"}
+    if prof is not None:
+        runner = prof.DeadlineRunner(deadline_ts=time.time() + 3600)
+        env5 = dict(env4)
+
+        def _breakdown_stage(t):
+            return bench._run_json_cmd(
+                [sys.executable, os.path.join(_HERE, "tpu_breakdown.py")],
+                env5, cwd=_ROOT, timeout=t)
+
+        rec = runner.run("breakdown_overbudget", _breakdown_stage,
+                         kill_after)
+        # the window must remain usable after the kill: a trivially
+        # cheap follow-up stage still runs to completion
+        rec2 = runner.run("followup",
+                          lambda t: ({"ok": True, "timeout_given": t},
+                                     None), budget_s=60)
+        exhausted = prof.DeadlineRunner(deadline_ts=time.time() + 5)
+        rec3 = exhausted.run("wont_fit", _breakdown_stage, kill_after)
+        dr = {
+            "killed_at_budget": bool(rec.get("hit_budget")),
+            "banked_partial": bool(rec.get("banked_partial")),
+            "banked_sections": sorted(
+                k for k in (rec.get("result") or {})
+                if k in ("dispatch_ms", "matvec_ms", "sweep_ms",
+                         "niter_points_partial")),
+            "window_still_usable": bool(rec2.get("ok")),
+            "exhausted_window_skips": bool(rec3.get("skipped")),
+            "report": runner.report(),
+            "ok": bool(rec.get("hit_budget") and rec.get("banked_partial")
+                       and rec2.get("ok") and rec3.get("skipped")),
+        }
+    art["deadline_runner"] = dr
+
     # ---- pass 4: rehearsal caches must NEVER read as TPU evidence ----
     merged = bench._merge_tpu_cache(
         {"platform": "cpu", "value": 1.0, "degraded": True},
@@ -200,6 +278,8 @@ def main() -> None:
 
     art["ok"] = bool(art["ladder_ok"] and art["salvage"]["ok"]
                      and art["breakdown_salvage"]["ok"]
+                     and art["deadline_runner"]["ok"]
+                     and art["deadline_records_ok"]
                      and art["no_false_promotion"]["ok"])
     out_path = os.path.join(_HERE, "rehearsal_r04.json")
     with open(out_path, "w") as f:
@@ -210,6 +290,9 @@ def main() -> None:
                       "salvage_ok": art["salvage"]["ok"],
                       "breakdown_salvage_ok":
                           art["breakdown_salvage"]["ok"],
+                      "deadline_runner_ok":
+                          art["deadline_runner"]["ok"],
+                      "deadline_records_ok": art["deadline_records_ok"],
                       "no_false_promotion":
                           art["no_false_promotion"]["ok"],
                       "artifact": out_path}))

@@ -25,6 +25,14 @@ round-long harvest:
 so the round artifact contains a TPU number if *any* probe during the
 round found the tunnel up.
 
+Round 9: stages run through the diagnostics ``DeadlineRunner``
+(``pylops_mpi_tpu/diagnostics/profiler.py`` — also the ONE per-stage
+wall-budget table, shared with ``bench.py`` and
+``benchmarks/rehearse_ladder.py``): per-stage timeouts are capped at
+the remaining window, a stage killed at budget still banks its
+salvaged partial line, and stages the window cannot fit are skipped so
+the window is yielded instead of eaten.
+
 Run: ``python benchmarks/tpu_probe_loop.py [--interval 180]
 [--max-hours 11] [--once]``. Exits when the full flagship is cached
 (mission complete) or at ``--max-hours``.
@@ -83,19 +91,43 @@ def _bench_mod():
     return bench
 
 
+def _profiler_mod():
+    """The diagnostics profiler (central stage-budget table + deadline
+    runner), loaded by file path through bench.py's helper so this
+    long-lived supervisor never imports the package (or jax)."""
+    return _bench_mod()._profiler_mod()
+
+
+def _budget(stage: str, rehearse: bool = False) -> int:
+    """Stage wall budget from the ONE central table
+    (``pylops_mpi_tpu/diagnostics/profiler.py``; env overrides via the
+    historical ``PROBE_*_TIMEOUT`` names), with the pre-round-9
+    literals as a last-resort fallback."""
+    _FALLBACK = {"selfcheck": 900, "flagship_small": 900,
+                 "fft_planar": 700, "flagship_full": 3000,
+                 "flagship_mid": 1200, "overlap": 600, "bisect": 1200,
+                 "breakdown": 900, "diag": 900}
+    mod = _profiler_mod()
+    if mod is None:
+        return _FALLBACK[stage]
+    try:
+        return mod.stage_budget(stage, rehearse=rehearse)
+    except Exception:
+        return _FALLBACK[stage]
+
+
 def probe(timeout: int = 120) -> tuple:
     """(status, detail): status is the backend name or "dead"."""
     return _bench_mod()._tpu_probe(timeout)
 
 
-def _stage_selfcheck(env):
+def _stage_selfcheck(env, timeout):
     return _bench_mod()._run_json_cmd(
         [sys.executable, os.path.join(_HERE, "tpu_selfcheck.py")], env,
-        timeout=int(os.environ.get("PROBE_SELFCHECK_TIMEOUT", "900")),
-        cwd=_ROOT)
+        timeout=timeout, cwd=_ROOT)
 
 
-def _stage_diag(env):
+def _stage_diag(env, timeout):
     """Piecewise on-hardware diagnosis (benchmarks/tpu_diag.py): full
     tracebacks for anything the selfcheck flagged, plus on-hardware
     validation of fixes made since the last window. Output is the list
@@ -105,7 +137,7 @@ def _stage_diag(env):
         p = subprocess.run(
             [sys.executable, "-u", os.path.join(_HERE, "tpu_diag.py")],
             capture_output=True, text=True, cwd=_ROOT, env=env,
-            timeout=int(os.environ.get("PROBE_DIAG_TIMEOUT", "900")))
+            timeout=timeout)
         steps, backend = [], None
         for line in (p.stdout or "").splitlines():
             line = line.strip()
@@ -145,7 +177,7 @@ def _stage_diag(env):
                 "diag timeout" if steps else "diag timeout with no steps")
 
 
-def _stage_bisect(env):
+def _stage_bisect(env, timeout):
     """Complex-support bisect (benchmarks/tpu_fft_bisect.py): the
     round-5 selfcheck showed every real kernel green and the pencil
     FFT dead with runtime UNIMPLEMENTED even on the matmul engine.
@@ -156,11 +188,10 @@ def _stage_bisect(env):
     return _bench_mod()._run_json_cmd(
         [sys.executable, "-u",
          os.path.join(_HERE, "tpu_fft_bisect.py"), "--timeout", "150"],
-        env, timeout=int(os.environ.get("PROBE_BISECT_TIMEOUT", "1200")),
-        cwd=_ROOT)
+        env, timeout=timeout, cwd=_ROOT)
 
 
-def _stage_fft_planar(env):
+def _stage_fft_planar(env, timeout):
     """Cheap planar-FFT hardware probe (tpu_fft_bisect.py --planar,
     seconds per child): validates the complex-free distributed FFT
     mode — planar 1-D engine, planar pencil, plane-aware fwd+adj API,
@@ -171,12 +202,10 @@ def _stage_fft_planar(env):
         [sys.executable, "-u",
          os.path.join(_HERE, "tpu_fft_bisect.py"), "--planar",
          "--timeout", "150"],
-        env,
-        timeout=int(os.environ.get("PROBE_FFT_PLANAR_TIMEOUT", "700")),
-        cwd=_ROOT)
+        env, timeout=timeout, cwd=_ROOT)
 
 
-def _stage_overlap(env):
+def _stage_overlap(env, timeout):
     """Bulk-vs-pipelined schedule races (round 8): the summa_overlap
     and pencil_a2a_chunked rows in one subprocess
     (bench_components.py --overlap-stage). On hardware the rows stamp
@@ -186,39 +215,37 @@ def _stage_overlap(env):
     return _bench_mod()._run_json_cmd(
         [sys.executable, "-u",
          os.path.join(_HERE, "bench_components.py"), "--overlap-stage"],
-        env, timeout=int(os.environ.get("PROBE_OVERLAP_TIMEOUT", "600")),
-        cwd=_ROOT)
+        env, timeout=timeout, cwd=_ROOT)
 
 
-def _stage_breakdown(env):
+def _stage_breakdown(env, timeout):
     """Latency attribution for the flagship (benchmarks/tpu_breakdown.py):
     fixed-vs-marginal niter fit, standalone sweep time, reduction
     overhead — the round-3 weak-#1 diagnosis, on hardware."""
     return _bench_mod()._run_json_cmd(
         [sys.executable, os.path.join(_HERE, "tpu_breakdown.py")], env,
-        timeout=int(os.environ.get("PROBE_BREAKDOWN_TIMEOUT", "900")),
-        cwd=_ROOT)
+        timeout=timeout, cwd=_ROOT)
 
 
-def _stage_flagship(env, size: str):
+def _stage_flagship(env, size: str, timeout):
     env = dict(env)
     if size == "small":
         env["BENCH_NBLOCK_PYLOPS_MPI_TPU"] = "1024"
         env["BENCH_NITER_PYLOPS_MPI_TPU"] = "20"
         env["BENCH_COMPONENTS_PYLOPS_MPI_TPU"] = "0"
         env["BENCH_SELFCHECK_PYLOPS_MPI_TPU"] = "0"  # stage 1 covers it
-        timeout = int(os.environ.get("PROBE_SMALL_TIMEOUT", "900"))
     elif size == "mid":
         # banked mid-size headline: big enough to mean something
         # (2048² blocks), cheap enough to survive a short window;
-        # components/selfcheck stay off (own stages cover them)
-        env["BENCH_NBLOCK_PYLOPS_MPI_TPU"] = "2048"
+        # components/selfcheck stay off (own stages cover them).
+        # PROBE_MID_NBLOCK exists for the CPU rehearsal on slow hosts
+        # (a 1-core driver container cannot fit 2048² in the budget);
+        # real windows keep the 2048 default
+        env["BENCH_NBLOCK_PYLOPS_MPI_TPU"] = env.get(
+            "PROBE_MID_NBLOCK", "2048")
         env["BENCH_NITER_PYLOPS_MPI_TPU"] = "30"
         env["BENCH_COMPONENTS_PYLOPS_MPI_TPU"] = "0"
         env["BENCH_SELFCHECK_PYLOPS_MPI_TPU"] = "0"
-        timeout = int(os.environ.get("PROBE_MID_TIMEOUT", "1200"))
-    else:
-        timeout = int(os.environ.get("PROBE_FULL_TIMEOUT", "3000"))
     return _bench_mod()._run_json_cmd(
         [sys.executable, os.path.join(_ROOT, "bench.py"), "--child"],
         env, timeout=timeout, cwd=_ROOT)
@@ -247,13 +274,25 @@ def rehearse_env(env: dict) -> dict:
     return env
 
 
-def harvest(cache: dict, rehearse: bool = False) -> dict:
+def harvest(cache: dict, rehearse: bool = False,
+            deadline_ts: float = None) -> dict:
     """One live window: run whatever stages aren't cached yet; persist
     after each. Returns the updated cache. Cached entries are keyed to
     the git revision that produced them — a stage harvested from older
     code re-runs so fixes get re-validated on hardware (the flagship
     artifact-merge in bench.py still falls back to any-rev cached TPU
     numbers, old beats none).
+
+    Stages run through the diagnostics ``DeadlineRunner`` (round 9):
+    per-stage budgets come from the ONE central table
+    (``pylops_mpi_tpu/diagnostics/profiler.py``, env overrides via the
+    historical ``PROBE_*_TIMEOUT`` names), each stage's timeout is
+    capped at the remaining window, a stage killed at budget still
+    BANKS its salvaged partial line (recorded as ``banked_partial``),
+    and stages the remaining window cannot fit are SKIPPED — the
+    round-5 failure (a 900 s stage eating a ~20-minute window) cannot
+    recur. The runner's per-stage record is persisted in each cache
+    entry under ``"deadline"``.
 
     ``rehearse``: run the EXACT stage ladder on CPU (forced platform,
     8-virtual-device mesh, TPU-style headline-first component ordering)
@@ -272,19 +311,22 @@ def harvest(cache: dict, rehearse: bool = False) -> dict:
         # missing for five rounds) BEFORE the 900 s+ diagnosis stages
         # (breakdown/diag) get a chance to eat the window. flagship_mid
         # stays as the consolation headline if full dies mid-stage.
-        ("selfcheck", lambda: _stage_selfcheck(env)),
-        ("flagship_small", lambda: _stage_flagship(env, "small")),
-        ("fft_planar", lambda: _stage_fft_planar(env)),
-        ("flagship_full", lambda: _stage_flagship(env, "full")),
-        ("flagship_mid", lambda: _stage_flagship(env, "mid")),
+        ("selfcheck", lambda t: _stage_selfcheck(env, t)),
+        ("flagship_small", lambda t: _stage_flagship(env, "small", t)),
+        ("fft_planar", lambda t: _stage_fft_planar(env, t)),
+        ("flagship_full", lambda t: _stage_flagship(env, "full", t)),
+        ("flagship_mid", lambda t: _stage_flagship(env, "mid", t)),
         # overlap races sit AFTER the flagship stages by design (ISSUE
         # 3): a schedule race must never push the N=4096 headline back
-        ("overlap", lambda: _stage_overlap(env)),
-        ("bisect", lambda: _stage_bisect(env)),
-        ("breakdown", lambda: _stage_breakdown(env)),
-        ("diag", lambda: _stage_diag(env)),
+        ("overlap", lambda t: _stage_overlap(env, t)),
+        ("bisect", lambda t: _stage_bisect(env, t)),
+        ("breakdown", lambda t: _stage_breakdown(env, t)),
+        ("diag", lambda t: _stage_diag(env, t)),
     ]
-    for name, runner in stages:
+    pmod = _profiler_mod()
+    runner = (pmod.DeadlineRunner(deadline_ts=deadline_ts)
+              if pmod is not None else None)
+    for name, stage_fn in stages:
         prev = cache.get(name)
         # a rehearsal must NEVER overwrite banked hardware evidence —
         # a real-TPU entry outranks any CPU rehearsal result even when
@@ -303,10 +345,29 @@ def harvest(cache: dict, rehearse: bool = False) -> dict:
                 not prev.get("error") and \
                 prev.get("code_rev") == rev:
             continue  # harvested on an earlier window, same code
-        t0 = time.time()
-        result, err = runner()
-        entry = {"ts": _now(), "seconds": round(time.time() - t0, 1),
-                 "result": result, "code_rev": rev}
+        budget = _budget(name, rehearse=rehearse)
+        if runner is not None:
+            rec = runner.run(name, stage_fn, budget)
+            if rec.get("skipped"):
+                # remaining window can't fit anything useful: yield it
+                # (re-probe later) instead of starting a doomed stage
+                _log({"status": "stage_skipped", "stage": name,
+                      "note": rec.get("reason", "deadline")})
+                break
+            result = rec.get("result")
+            err = rec.get("error")
+            seconds = rec["seconds"]
+            deadline_rec = {k: rec[k] for k in
+                            ("budget_s", "effective_timeout_s",
+                             "hit_budget", "banked_partial")}
+        else:  # no diagnostics module: pre-round-9 behavior
+            t0 = time.time()
+            result, err = stage_fn(budget)
+            seconds = round(time.time() - t0, 1)
+            deadline_rec = {"budget_s": budget}
+        entry = {"ts": _now(), "seconds": seconds,
+                 "result": result, "code_rev": rev,
+                 "deadline": deadline_rec}
         if rehearse:
             # explicit provenance: bench.py's cache merge must never
             # mistake an all-probes-failed rehearsal (no per-probe
@@ -318,7 +379,7 @@ def harvest(cache: dict, rehearse: bool = False) -> dict:
         _save_cache(cache)
         _log({"status": "stage", "stage": name,
               "ok": result is not None and not err,
-              "seconds": entry["seconds"],
+              "seconds": seconds, **deadline_rec,
               **({"error": err} if err else {})})
         if result is None:
             break  # window probably died; re-probe before continuing
@@ -408,7 +469,8 @@ def main() -> None:
         status, detail = probe(args.probe_timeout)
         _log({"status": status, **({"detail": detail} if detail else {})})
         if status == "tpu" or (args.rehearse and status != "dead"):
-            cache = harvest(_load_cache(), rehearse=args.rehearse)
+            cache = harvest(_load_cache(), rehearse=args.rehearse,
+                            deadline_ts=deadline)
             full = cache.get("flagship_full", {})
             res = full.get("result")
             # platform must really be "tpu": a tunnel drop mid-stage

@@ -85,7 +85,9 @@ PAGES = {
          ["benchmark", "mark", "profile_trace"]),
         ("Collective-schedule inspection", "pylops_mpi_tpu.utils.hlo",
          ["collective_report", "assert_no_full_gather",
-          "parse_hlo_collectives"]),
+          "parse_hlo_collectives", "count_collectives",
+          "assert_ring_schedule", "count_host_callbacks",
+          "assert_no_host_callbacks"]),
         ("Checkpointing", "pylops_mpi_tpu.utils.checkpoint",
          ["save_solver", "load_solver"]),
         ("FFT helpers", "pylops_mpi_tpu.utils.fft_helper",
@@ -99,6 +101,24 @@ PAGES = {
           "write_binary", "write_binary_at", "local_split_native"]),
         ("Plotting", "pylops_mpi_tpu.plotting.plotting",
          ["plot_distributed_array", "plot_local_arrays"]),
+    ],
+    "diagnostics": [
+        ("Structured tracing", "pylops_mpi_tpu.diagnostics.trace",
+         ["trace_mode", "trace_enabled", "span", "op_span", "event",
+          "counter", "get_events", "clear_events", "dump", "span_tree"]),
+        ("Cost models and roofline",
+         "pylops_mpi_tpu.diagnostics.costmodel",
+         ["OpCost", "estimate", "register_cost", "roofline",
+          "summa_comm_volume", "pencil_transpose_cost", "peak_flops",
+          "peak_hbm_gbps", "peak_ici_gbps", "device_peaks"]),
+        ("In-loop solver telemetry",
+         "pylops_mpi_tpu.diagnostics.telemetry",
+         ["telemetry_enabled", "telemetry_signature", "iteration",
+          "history", "clear_history"]),
+        ("Profiler hooks and harvest budgets",
+         "pylops_mpi_tpu.diagnostics.profiler",
+         ["stage_budget", "DeadlineRunner", "profile_capture",
+          "profile_dir"]),
     ],
     "models": [
         ("Model workflows", "pylops_mpi_tpu.models",
@@ -117,6 +137,7 @@ PAGE_TITLES = {
     "solvers": "Solvers",
     "local": "Local operators and kernels",
     "utils": "Utilities",
+    "diagnostics": "Diagnostics and observability",
     "models": "Model workflows",
 }
 

@@ -40,6 +40,7 @@ from .solvers.eigs import power_iteration
 from .utils.dottest import dottest
 from .plotting.plotting import plot_distributed_array, plot_local_arrays
 
+from . import diagnostics
 from . import ops
 from . import solvers
 from . import utils

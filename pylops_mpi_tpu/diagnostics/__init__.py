@@ -1,0 +1,59 @@
+"""Runtime observability subsystem (round 9).
+
+The reference ships no runtime introspection at all; after three perf
+rounds this repo had many tuned kernels and zero visibility into where
+time, bytes and iterations actually go (VERDICT round 5: a 900 s
+harvest stage burned a rare ~20-minute TPU window producing nothing).
+Four modules make the folklore first-class:
+
+- :mod:`~pylops_mpi_tpu.diagnostics.trace` — structured span tracer
+  (context-manager API, nested spans, thread-safe ring buffer) emitting
+  Chrome-trace-event JSONL, gated by ``PYLOPS_MPI_TPU_TRACE``; wired
+  through every operator ``matvec``/``rmatvec``, the hand-scheduled
+  collectives, and the solver entry points.
+- :mod:`~pylops_mpi_tpu.diagnostics.costmodel` — per-op cost registry
+  (FLOPs, HBM bytes, ICI bytes per apply) generalizing the comm-volume
+  model previously private to ``ops/matrixmult.py``'s auto-select,
+  plus the per-chip peak tables and a roofline predictor
+  (``bench.py`` stamps predicted-vs-measured on every row).
+- :mod:`~pylops_mpi_tpu.diagnostics.telemetry` — per-iteration
+  convergence telemetry captured from INSIDE the fused solver
+  ``while_loop``\\ s via ``jax.debug.callback``; off by default, with
+  an HLO pin (``utils/hlo.py::assert_no_host_callbacks``) proving the
+  donated/fused hot path carries zero host callbacks when disabled.
+- :mod:`~pylops_mpi_tpu.diagnostics.profiler` — ``jax.profiler``
+  trace-capture hooks plus the deadline-aware stage runner and the
+  central per-stage wall-budget table consumed by the harvest ladder
+  (``bench.py``, ``benchmarks/tpu_probe_loop.py``,
+  ``benchmarks/rehearse_ladder.py``).
+
+See ``docs/observability.md`` for the env knobs and artifact schema.
+"""
+
+from . import trace
+from . import costmodel
+from . import telemetry
+from . import profiler
+
+from .trace import (trace_mode, trace_enabled, span, event, counter,
+                    get_events, clear_events, dump, span_tree)
+from .costmodel import (OpCost, estimate, register_cost, roofline,
+                        summa_comm_volume, pencil_transpose_cost,
+                        peak_flops, peak_hbm_gbps, peak_ici_gbps,
+                        device_peaks)
+from .telemetry import (telemetry_enabled, iteration, history,
+                        clear_history, telemetry_signature)
+from .profiler import (STAGE_BUDGETS, stage_budget, DeadlineRunner,
+                       profile_capture)
+
+__all__ = [
+    "trace", "costmodel", "telemetry", "profiler",
+    "trace_mode", "trace_enabled", "span", "event", "counter",
+    "get_events", "clear_events", "dump", "span_tree",
+    "OpCost", "estimate", "register_cost", "roofline",
+    "summa_comm_volume", "pencil_transpose_cost", "peak_flops",
+    "peak_hbm_gbps", "peak_ici_gbps", "device_peaks",
+    "telemetry_enabled", "iteration", "history", "clear_history",
+    "telemetry_signature",
+    "STAGE_BUDGETS", "stage_budget", "DeadlineRunner", "profile_capture",
+]

@@ -1,0 +1,271 @@
+"""Profiler hooks and the deadline-aware harvest-stage runner.
+
+Two jobs, both born from VERDICT round 5 ("a 900 s harvest stage
+burned a rare ~20-minute TPU window producing nothing"):
+
+1. :func:`profile_capture` — ``jax.profiler`` trace-capture around a
+   region (the XLA/device-level view the host-side span tracer cannot
+   give), gated by an env dir so any harvest stage can be captured
+   without code changes.
+
+2. :class:`DeadlineRunner` + :data:`STAGE_BUDGETS` — the central
+   per-stage wall-budget table for the harvest ladder (previously the
+   900 s-class limits were duplicated inline across ``bench.py``,
+   ``benchmarks/tpu_probe_loop.py`` and
+   ``benchmarks/rehearse_ladder.py``) and a runner that (a) caps each
+   stage's timeout at ``min(budget, window remaining)``, (b) records
+   whether a killed stage still BANKED a partial artifact (the
+   ``_run_json_cmd`` salvage), and (c) SKIPS stages the remaining
+   window cannot fit — yielding the window instead of eating it.
+
+STANDALONE-LOADABLE BY DESIGN: module-level imports are stdlib only
+and there are no relative imports, so the probe daemon's jax-free
+parent process loads this file directly via
+``importlib.util.spec_from_file_location`` (see
+``benchmarks/tpu_probe_loop.py::_profiler_mod``) without pulling the
+package (and jax) into the long-lived supervisor. Trace emission is
+lazy and guarded for the same reason.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["STAGE_BUDGETS", "stage_budget", "DeadlineRunner",
+           "StageRecord", "profile_capture", "profile_dir"]
+
+
+# ------------------------------------------------------------ budget table
+# Per-stage wall budgets, seconds. ONE table, two columns:
+#   "tpu"      — the live-window budget the probe daemon enforces
+#                (previously the PROBE_*_TIMEOUT inline defaults in
+#                tpu_probe_loop.py);
+#   "rehearse" — the CPU-rehearsal enforcement budget
+#                (previously rehearse_ladder.py's BUDGETS dict).
+# Env override names are unchanged (PROBE_<STAGE>_TIMEOUT, with the
+# historical "flagship_" prefix dropped: PROBE_SMALL_TIMEOUT etc.), so
+# existing harvest configs keep working.
+STAGE_BUDGETS: Dict[str, Dict[str, Optional[int]]] = {
+    "selfcheck":      {"tpu": 900,  "rehearse": 600},
+    "flagship_small": {"tpu": 900,  "rehearse": 600},
+    "fft_planar":     {"tpu": 700,  "rehearse": 600},
+    "flagship_full":  {"tpu": 3000, "rehearse": 2400},
+    "flagship_mid":   {"tpu": 1200, "rehearse": 1200},
+    "overlap":        {"tpu": 600,  "rehearse": 600},
+    "bisect":         {"tpu": 1200, "rehearse": 900},
+    "breakdown":      {"tpu": 900,  "rehearse": 700},
+    "diag":           {"tpu": 900,  "rehearse": 700},
+    # bench-child internal budgets (bench.py consumes these directly):
+    # the pre-headline selfcheck subprocess and the per-component cap
+    "bench_selfcheck": {"tpu": 600, "rehearse": 600},
+    "component":       {"tpu": 150, "rehearse": 150},
+}
+
+_ENV_NAMES = {
+    "bench_selfcheck": "BENCH_SELFCHECK_TIMEOUT",
+    "component": "BENCH_COMPONENT_TIMEOUT",
+}
+
+
+def _env_name(stage: str) -> str:
+    if stage in _ENV_NAMES:
+        return _ENV_NAMES[stage]
+    return "PROBE_" + stage.replace("flagship_", "").upper() + "_TIMEOUT"
+
+
+def stage_budget(stage: str, rehearse: bool = False,
+                 env: Optional[Dict] = None) -> int:
+    """Wall budget (seconds) for one harvest stage: the env override
+    (``PROBE_<STAGE>_TIMEOUT`` / ``BENCH_*_TIMEOUT``) when set and
+    parseable, else the table column for the flavor. Unknown stages
+    raise — a typo'd stage name must not silently get some default."""
+    if stage not in STAGE_BUDGETS:
+        raise KeyError(f"unknown harvest stage {stage!r}; known: "
+                       f"{sorted(STAGE_BUDGETS)}")
+    env = os.environ if env is None else env
+    raw = env.get(_env_name(stage))
+    if raw is not None:
+        try:
+            return int(raw)
+        except ValueError:
+            pass  # malformed override: fall through to the table
+    return STAGE_BUDGETS[stage]["rehearse" if rehearse else "tpu"]
+
+
+# --------------------------------------------------------- deadline runner
+class StageRecord(dict):
+    """One stage outcome (a plain dict for easy JSON banking):
+    ``stage``, ``budget_s``, ``effective_timeout_s``, ``seconds``,
+    ``ok``, ``skipped``, ``banked_partial``, ``hit_budget``,
+    ``error``. ``result`` holds the stage's parsed artifact (may be a
+    salvaged partial)."""
+
+    @property
+    def result(self):
+        return self.get("result")
+
+
+class DeadlineRunner:
+    """Run harvest stages against a hard window deadline.
+
+    ``fn`` passed to :meth:`run` receives the EFFECTIVE timeout
+    (seconds) and returns ``(result, err)`` in the
+    ``bench._run_json_cmd`` convention — ``result`` may be a salvaged
+    partial line when the child was killed at the timeout (detected
+    here via its ``salvaged_after_timeout`` stamp). The runner:
+
+    - caps each stage at ``min(budget, remaining window)`` (a stage
+      never eats past the deadline);
+    - skips a stage outright when the remaining window is under
+      ``min_stage_s`` (better to yield the window for the next probe
+      than to start a stage that cannot finish);
+    - records every outcome (:attr:`records`) — including whether a
+      killed stage still banked a partial artifact — and emits a
+      structured trace event per stage when the trace layer is
+      available and enabled.
+    """
+
+    def __init__(self, deadline_ts: Optional[float] = None,
+                 min_stage_s: int = 30,
+                 log: Optional[Callable[[Dict], None]] = None):
+        self.deadline_ts = deadline_ts
+        self.min_stage_s = int(min_stage_s)
+        self._log = log
+        self.records: List[StageRecord] = []
+
+    def remaining(self) -> Optional[float]:
+        """Seconds left in the window (None = no deadline)."""
+        if self.deadline_ts is None:
+            return None
+        return self.deadline_ts - time.time()
+
+    def _emit(self, rec: StageRecord) -> None:
+        self.records.append(rec)
+        payload = {k: v for k, v in rec.items() if k != "result"}
+        if self._log is not None:
+            try:
+                self._log(dict(payload))
+            except Exception:
+                pass
+        try:
+            # only if the trace layer is ALREADY imported: this module
+            # is file-path-loaded by jax-free supervisors, and emitting
+            # here must never pull the package (and jax) into them
+            import sys
+            tr = sys.modules.get("pylops_mpi_tpu.diagnostics.trace")
+            if tr is not None:
+                tr.event(f"harvest.{rec['stage']}", cat="harvest",
+                         **payload)
+        except Exception:
+            pass
+
+    def run(self, stage: str, fn: Callable, budget_s: int) -> StageRecord:
+        rem = self.remaining()
+        if rem is not None and rem < min(budget_s, self.min_stage_s):
+            rec = StageRecord(stage=stage, budget_s=budget_s,
+                              skipped=True, ok=False,
+                              reason="window exhausted "
+                                     f"({rem:.0f}s remaining)",
+                              result=None)
+            self._emit(rec)
+            return rec
+        eff = int(budget_s) if rem is None \
+            else max(1, min(int(budget_s), int(rem)))
+        t0 = time.time()
+        try:
+            result, err = fn(eff)
+        except Exception as e:  # a crashing stage must not end the window
+            result, err = None, f"stage raised: {e!r}"
+        seconds = round(time.time() - t0, 1)
+        banked_partial = bool(
+            isinstance(result, dict)
+            and (result.get("salvaged_after_timeout")
+                 or result.get("partial")))
+        rec = StageRecord(
+            stage=stage, budget_s=int(budget_s),
+            effective_timeout_s=eff, seconds=seconds,
+            ok=result is not None and not err,
+            skipped=False,
+            hit_budget=seconds >= eff - 1,
+            banked_partial=banked_partial,
+            result=result)
+        if err:
+            rec["error"] = str(err)[:300]
+        self._emit(rec)
+        return rec
+
+    def report(self) -> Dict:
+        """Summary for artifacts: per-stage outcomes (without the
+        payloads) + whether the window was yielded with stages
+        unrun."""
+        return {
+            "stages": [{k: v for k, v in r.items() if k != "result"}
+                       for r in self.records],
+            "skipped": [r["stage"] for r in self.records
+                        if r.get("skipped")],
+            "banked_partials": [r["stage"] for r in self.records
+                                if r.get("banked_partial")],
+            "remaining_s": (None if self.deadline_ts is None
+                            else round(self.remaining(), 1)),
+        }
+
+
+# ------------------------------------------------------------ jax.profiler
+def profile_dir() -> Optional[str]:
+    """``PYLOPS_MPI_TPU_PROFILE_DIR`` — when set, the solvers' /
+    bench's :func:`profile_capture` regions actually capture; unset
+    (default) they are no-ops."""
+    return os.environ.get("PYLOPS_MPI_TPU_PROFILE_DIR") or None
+
+
+class _NoopCapture:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def profile_capture(name: str, logdir: Optional[str] = None):
+    """Context manager: capture a ``jax.profiler`` trace of the region
+    into ``logdir`` (default: ``$PYLOPS_MPI_TPU_PROFILE_DIR/<name>``;
+    no-op when neither is set, or when the profiler cannot start —
+    e.g. a second concurrent capture). TensorBoard/XProf-compatible;
+    this is the DEVICE-side complement of the host-side span tracer
+    (``diagnostics/trace.py``)."""
+    base = logdir or profile_dir()
+    if not base:
+        return _NoopCapture()
+    path = os.path.join(base, name) if logdir is None else logdir
+
+    class _Capture:
+        def __enter__(self):
+            self._on = False
+            try:
+                import jax.profiler
+                os.makedirs(path, exist_ok=True)
+                jax.profiler.start_trace(path)
+                self._on = True
+            except Exception:
+                pass  # profiling must never break the workload
+            return self
+
+        def __exit__(self, *exc):
+            if self._on:
+                try:
+                    import jax.profiler
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+            return False
+
+    return _Capture()
+
+
+# convenience for scripts that bank runner reports next to artifacts
+def dump_report(runner: DeadlineRunner, path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(runner.report(), f, indent=1)

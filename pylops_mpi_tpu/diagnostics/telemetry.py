@@ -1,0 +1,150 @@
+"""In-loop solver telemetry (per-iteration convergence scalars).
+
+The fused CG/CGLS/ISTA/FISTA solvers run as ONE ``lax.while_loop``
+under ``jit`` — the whole point of the design is that no scalar
+crosses the host boundary per iteration. That also means convergence
+is invisible until the solve returns. This module captures
+per-iteration scalars (residual norms, recurrence/step quantities)
+from INSIDE the fused loops via ``jax.debug.callback``, recording each
+sample both in a host-side history (:func:`history`) and as a Chrome
+counter event in the trace buffer (:mod:`.trace`), so one solve's
+JSONL artifact carries the convergence trajectory next to the
+operator/collective spans.
+
+OFF BY DEFAULT, and provably free when off: :func:`iteration` returns
+before touching jax, so a disabled build traces NOTHING into the loop
+body — ``utils/hlo.py::assert_no_host_callbacks`` pins that the
+compiled fused programs contain zero host callbacks, leaving the
+donated/fused hot path untouched (bit-identical HLO).
+
+Gating: ``PYLOPS_MPI_TPU_TELEMETRY`` = ``auto`` (default; on exactly
+when ``PYLOPS_MPI_TPU_TRACE=full``) | ``on`` | ``off``. The fused
+solver cache keys on :func:`telemetry_signature` (``solvers/basic.py
+_get_fused``) so flipping the gate retraces instead of silently
+reusing an executable compiled under the other mode.
+
+Caveats: ``jax.debug.callback`` samples arrive asynchronously
+(``ordered=False``) — within one solve they are monotone in practice
+but callers should sort by ``iiter`` (``history`` does); masked
+vectors make the recurrence scalars per-group VECTORS, stored as
+lists. The callback costs a device→host sync per iteration — this is
+a diagnosis mode, not a production one.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from functools import partial
+from typing import Dict, List, Optional
+
+from . import trace
+
+__all__ = ["telemetry_enabled", "telemetry_signature", "iteration",
+           "history", "clear_history"]
+
+_LOCK = threading.Lock()
+_HISTORY: Dict[str, List[Dict]] = {}
+_warned_mode = False
+
+
+def _mode() -> str:
+    global _warned_mode
+    m = os.environ.get("PYLOPS_MPI_TPU_TELEMETRY", "auto").strip().lower()
+    if m in ("", "none", "default"):
+        m = "auto"
+    if m in ("1", "true"):
+        m = "on"
+    if m in ("0", "false"):
+        m = "off"
+    if m not in ("auto", "on", "off"):
+        if not _warned_mode:
+            import warnings
+            warnings.warn(
+                f"PYLOPS_MPI_TPU_TELEMETRY={m!r} is not one of "
+                "['auto', 'on', 'off']; using 'auto'", stacklevel=2)
+            _warned_mode = True
+        m = "auto"
+    return m
+
+
+def telemetry_enabled() -> bool:
+    """True when per-iteration capture is active: explicit ``on``, or
+    ``auto`` with the trace layer in ``full`` mode."""
+    m = _mode()
+    if m == "on":
+        return True
+    if m == "off":
+        return False
+    return trace.trace_mode() == "full"
+
+
+def telemetry_signature():
+    """Hashable compile-relevant state for the fused-solver cache key:
+    a program traced with telemetry on embeds host callbacks, one
+    traced with it off must not — the two can never share an
+    executable (same pattern as the donation gate)."""
+    return ("telemetry", telemetry_enabled())
+
+
+def _to_host_value(v):
+    import numpy as np
+    a = np.asarray(v)
+    if a.size == 1:
+        return float(a.reshape(()))
+    return [float(x) for x in a.ravel()]
+
+
+def _record(solver: str, names, iiter, *vals) -> None:
+    """Host-side sink for the debug callback (runs OUTSIDE the traced
+    program): appends to the history and emits a Chrome counter."""
+    try:
+        it = int(_to_host_value(iiter))
+        sample = {"iiter": it}
+        counters = {}
+        for n, v in zip(names, vals):
+            hv = _to_host_value(v)
+            sample[n] = hv
+            if isinstance(hv, float):
+                counters[n] = hv
+        with _LOCK:
+            _HISTORY.setdefault(solver, []).append(sample)
+        trace.counter(f"solver.{solver}", {"iiter": it, **counters})
+    except Exception:
+        pass  # telemetry must never be able to kill a solve
+
+
+def iteration(solver: str, iiter, **scalars) -> None:
+    """Record one solver iteration from INSIDE a fused loop body.
+
+    ``iiter`` and the ``scalars`` values are traced jax scalars (or
+    per-mask-group vectors); ``solver`` and the scalar NAMES are
+    static. When telemetry is disabled this returns before touching
+    jax — nothing enters the traced program (the zero-host-callback
+    pin). When enabled it stages ONE ``jax.debug.callback`` per
+    iteration."""
+    if not telemetry_enabled():
+        return
+    import jax
+    names = tuple(scalars)
+    jax.debug.callback(partial(_record, solver, names), iiter,
+                       *scalars.values())
+
+
+def history(solver: Optional[str] = None) -> List[Dict]:
+    """Recorded samples (sorted by ``iiter``) for ``solver``, or the
+    whole ``{solver: samples}`` dict when ``solver`` is None."""
+    with _LOCK:
+        if solver is not None:
+            return sorted(_HISTORY.get(solver, ()),
+                          key=lambda s: s["iiter"])
+        return {k: sorted(v, key=lambda s: s["iiter"])
+                for k, v in _HISTORY.items()}
+
+
+def clear_history(solver: Optional[str] = None) -> None:
+    with _LOCK:
+        if solver is None:
+            _HISTORY.clear()
+        else:
+            _HISTORY.pop(solver, None)

@@ -1,0 +1,347 @@
+"""Structured span tracer — Chrome-trace-event JSONL.
+
+The runtime-observability entry layer (round 9): a lightweight span
+tracer with a context-manager API, nested spans, monotonic timestamps
+and a thread-safe ring buffer. Every operator ``matvec``/``rmatvec``
+(``linearoperator.py``), every hand-scheduled collective
+(``parallel/collectives.py``: ``ring_pass``,
+``chunked_pencil_transpose``, ``plane_all_to_all``, the halo
+exchanges) and every solver call (``solvers/*``) opens a span tagged
+with shapes, dtypes, overlap mode and mesh axes. One-shot notes that
+previously went to stdout/logging (``resolve_chunks`` fallbacks, SUMMA
+schedule selection) land here as instant events, so they ride in the
+JSONL artifact instead of scrolling away.
+
+Gating — ``PYLOPS_MPI_TPU_TRACE``:
+
+- ``off`` (default): every entry point returns a shared no-op; the
+  only cost is one env lookup per call. Nothing is ever added to a
+  traced program, so compiled HLO is BIT-IDENTICAL to untraced runs
+  (the exact-equality overlap/precision suites pin this).
+- ``spans``: operator / collective / solver spans and structured
+  events are recorded.
+- ``full``: additionally enables the in-loop solver telemetry
+  (:mod:`.telemetry` — per-iteration residual norms via
+  ``jax.debug.callback``; the only mode that changes compiled
+  programs).
+
+Timestamp semantics: spans record HOST wall-clock (``perf_counter_ns``
+relative to process start). A span around code running under a ``jit``
+trace measures *trace time*, not device time — such spans are tagged
+``"jax_tracing": true``; they still carry the schedule metadata
+(shapes, chunk counts, byte estimates), which is their real payload.
+Device-side timing belongs to :mod:`.profiler`'s ``jax.profiler``
+capture.
+
+Events are Chrome trace-event dicts (``ph`` ``X``/``i``/``C``), one
+JSON object per line when dumped (``dump(path)``); set
+``PYLOPS_MPI_TPU_TRACE_FILE`` to auto-dump at process exit. Open in
+Perfetto via ``dump(path, fmt="chrome")`` (a single JSON array) or
+``jq -s . trace.jsonl > trace.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = ["trace_mode", "trace_enabled", "span", "op_span", "event",
+           "counter", "get_events", "clear_events", "dump", "span_tree"]
+
+_MODES = ("off", "spans", "full")
+_warned_mode = False
+
+
+def trace_mode() -> str:
+    """``PYLOPS_MPI_TPU_TRACE`` resolved to ``off``/``spans``/``full``
+    (unknown values fall back to ``off`` with a one-time warning — a
+    typo in a CI matrix must not silently flip tracing on). Read per
+    call (a dict lookup) so tests and long-lived sessions can flip the
+    env without a cache to reset."""
+    global _warned_mode
+    m = os.environ.get("PYLOPS_MPI_TPU_TRACE", "off").strip().lower()
+    if m in ("", "0", "none", "default"):
+        m = "off"
+    if m not in _MODES:
+        if not _warned_mode:
+            import warnings
+            warnings.warn(
+                f"PYLOPS_MPI_TPU_TRACE={m!r} is not one of {_MODES}; "
+                "tracing stays off", stacklevel=2)
+            _warned_mode = True
+        m = "off"
+    return m
+
+
+def trace_enabled() -> bool:
+    return trace_mode() != "off"
+
+
+def _buffer_size() -> int:
+    try:
+        return max(1024, int(os.environ.get(
+            "PYLOPS_MPI_TPU_TRACE_BUFFER", str(1 << 16))))
+    except ValueError:
+        return 1 << 16
+
+
+# Ring buffer of completed Chrome events. A deque with maxlen drops the
+# OLDEST events on overflow — a long solve can never grow host memory
+# unboundedly; raise PYLOPS_MPI_TPU_TRACE_BUFFER to keep more.
+_LOCK = threading.Lock()
+_BUF: deque = deque(maxlen=_buffer_size())
+_EPOCH_NS = time.perf_counter_ns()
+_tls = threading.local()  # per-thread open-span stack (nesting depth)
+_atexit_registered = False
+
+
+def _now_us() -> float:
+    return (time.perf_counter_ns() - _EPOCH_NS) / 1e3
+
+
+def _jsonable(v):
+    """Best-effort JSON-safe value: tuples/lists recurse, numpy/jax
+    scalars go through float/int, everything else falls back to
+    ``str`` — a span tag must never be able to crash the traced
+    workload."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, (tuple, list)):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    try:
+        import numpy as np
+        if isinstance(v, np.integer):
+            return int(v)
+        if isinstance(v, (np.floating, np.number)):
+            return float(v)
+    except Exception:
+        pass
+    return str(v)
+
+
+def _jax_tracing() -> bool:
+    """True when called under an active jax trace (jit/shard_map/vmap
+    tracing pass) — spans recorded there measure trace time, and are
+    tagged so readers never mistake them for device time."""
+    try:
+        import jax.core
+        return not jax.core.trace_state_clean()
+    except Exception:
+        return False
+
+
+def _record(ev: Dict) -> None:
+    global _atexit_registered
+    with _LOCK:
+        _BUF.append(ev)
+        if not _atexit_registered and os.environ.get(
+                "PYLOPS_MPI_TPU_TRACE_FILE"):
+            import atexit
+            atexit.register(_atexit_dump)
+            _atexit_registered = True
+
+
+def _atexit_dump() -> None:
+    path = os.environ.get("PYLOPS_MPI_TPU_TRACE_FILE")
+    if path:
+        try:
+            dump(path)
+        except Exception:
+            pass  # a failed flush must never mask the real exit status
+
+
+class _NoopSpan:
+    """Shared do-nothing context manager — the entire cost of tracing
+    when ``PYLOPS_MPI_TPU_TRACE=off`` (beyond the mode lookup)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def tag(self, **tags):
+        return self
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    """One open span: records a Chrome ``ph="X"`` (complete) event on
+    exit, carrying its nesting depth and parent name so span trees can
+    be rebuilt from the flat buffer (``span_tree``)."""
+
+    __slots__ = ("name", "args", "t0", "_depth", "_parent")
+
+    def __init__(self, name: str, args: Dict):
+        self.name = name
+        self.args = args
+        self.t0 = 0.0
+        self._depth = 0
+        self._parent = None
+
+    def tag(self, **tags) -> "_Span":
+        """Attach tags discovered mid-span (e.g. a resolved chunk
+        count) to the event that will be emitted at exit."""
+        self.args.update({k: _jsonable(v) for k, v in tags.items()})
+        return self
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        self._depth = len(stack)
+        self._parent = stack[-1].name if stack else None
+        stack.append(self)
+        self.t0 = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = _now_us()
+        stack = getattr(_tls, "stack", ())
+        if stack and stack[-1] is self:
+            stack.pop()
+        args = dict(self.args)
+        args["depth"] = self._depth
+        if self._parent is not None:
+            args["parent"] = self._parent
+        _record({"name": self.name, "ph": "X", "ts": round(self.t0, 3),
+                 "dur": round(t1 - self.t0, 3), "pid": os.getpid(),
+                 "tid": threading.get_ident(), "cat": args.pop(
+                     "cat", "span"), "args": args})
+        return False
+
+
+def span(name: str, cat: str = "span", **tags):
+    """Open a traced span (context manager). No-op when tracing is
+    off. ``tags`` become the Chrome event's ``args``; tags are
+    JSON-sanitized so arbitrary shapes/dtypes/meshes are safe to
+    pass. Spans nest: each records its depth and parent name."""
+    if trace_mode() == "off":
+        return _NOOP
+    args = {k: _jsonable(v) for k, v in tags.items()}
+    if _jax_tracing():
+        args["jax_tracing"] = True
+    args["cat"] = cat
+    return _Span(name, args)
+
+
+def op_span(op, which: str):
+    """Span for one operator apply — the wiring point used by
+    ``MPILinearOperator.matvec``/``rmatvec``. Tags: operator class,
+    operator shape, dtype, mesh axis names, and (when the operator
+    carries them) overlap mode / schedule / grid. Returns the shared
+    no-op when tracing is off so the eager hot path pays only the mode
+    lookup."""
+    if trace_mode() == "off":
+        return _NOOP
+    tags = {"op": type(op).__name__, "shape": getattr(op, "shape", None),
+            "dtype": getattr(op, "dtype", None)}
+    mesh = getattr(op, "mesh", None)
+    if mesh is not None:
+        tags["mesh_axes"] = getattr(mesh, "axis_names", None)
+    for extra in ("overlap", "schedule", "grid", "compute_dtype"):
+        v = getattr(op, extra, None)
+        if v is not None:
+            tags[extra] = v
+    return span(f"{type(op).__name__}.{which}", cat="operator", **tags)
+
+
+def event(name: str, cat: str = "event", **tags) -> None:
+    """Instant event (Chrome ``ph="i"``): the structured replacement
+    for one-shot stdout/log notes — ``resolve_chunks`` fallbacks,
+    SUMMA schedule selection — so they land in the JSONL artifact."""
+    if trace_mode() == "off":
+        return
+    args = {k: _jsonable(v) for k, v in tags.items()}
+    if _jax_tracing():
+        args["jax_tracing"] = True
+    _record({"name": name, "ph": "i", "s": "t", "ts": round(_now_us(), 3),
+             "pid": os.getpid(), "tid": threading.get_ident(),
+             "cat": cat, "args": args})
+
+
+def counter(name: str, values: Dict[str, float],
+            cat: str = "telemetry") -> None:
+    """Counter sample (Chrome ``ph="C"``): Perfetto renders these as
+    time-series tracks — the shape the per-iteration solver telemetry
+    lands in (:mod:`.telemetry`)."""
+    if trace_mode() == "off":
+        return
+    _record({"name": name, "ph": "C", "ts": round(_now_us(), 3),
+             "pid": os.getpid(), "tid": threading.get_ident(),
+             "cat": cat, "args": {k: _jsonable(v)
+                                  for k, v in values.items()}})
+
+
+def get_events() -> List[Dict]:
+    """Snapshot of the ring buffer (oldest first)."""
+    with _LOCK:
+        return list(_BUF)
+
+
+def clear_events() -> None:
+    with _LOCK:
+        _BUF.clear()
+
+
+def dump(path: str, fmt: str = "jsonl") -> int:
+    """Write the buffered events to ``path``: ``fmt="jsonl"`` (one
+    Chrome event object per line — the artifact format) or
+    ``fmt="chrome"`` (a single JSON array Perfetto/chrome://tracing
+    open directly). Returns the number of events written."""
+    events = get_events()
+    if fmt == "chrome":
+        with open(path, "w") as f:
+            json.dump(events, f)
+    elif fmt == "jsonl":
+        with open(path, "w") as f:
+            for ev in events:
+                f.write(json.dumps(ev) + "\n")
+    else:
+        raise ValueError(f"fmt={fmt!r}: expected 'jsonl' or 'chrome'")
+    return len(events)
+
+
+def span_tree(events: Optional[List[Dict]] = None) -> List[Dict]:
+    """Rebuild the span nesting from a flat event list: returns the
+    roots, each ``{"name", "dur", "args", "children": [...]}`` — the
+    verification handle for the nesting/ordering tests. Chrome ``X``
+    events carry explicit ``depth``; reconstruction scans per-thread in
+    END-time order (a parent's event is recorded after its
+    children's), pushing each span under the most recent deeper-or-
+    equal-depth run."""
+    if events is None:
+        events = get_events()
+    roots: List[Dict] = []
+    by_tid: Dict = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        by_tid.setdefault(ev.get("tid"), []).append(ev)
+    for tid_events in by_tid.values():
+        stack: List = []  # (depth, node) of spans awaiting a parent
+        for ev in tid_events:  # buffer order == end-time order
+            depth = (ev.get("args") or {}).get("depth", 0)
+            node = {"name": ev["name"], "ts": ev["ts"], "dur": ev["dur"],
+                    "args": ev.get("args", {}), "children": []}
+            while stack and stack[-1][0] > depth:
+                node["children"].append(stack.pop()[1])
+            node["children"].reverse()  # recorded youngest-first
+            if depth == 0:
+                roots.append(node)
+            else:
+                stack.append((depth, node))
+        # orphans (parent span still open at snapshot time)
+        roots.extend(n for _, n in stack)
+    roots.sort(key=lambda n: n["ts"])
+    return roots

@@ -62,21 +62,28 @@ class MPILinearOperator:
     # ------------------------------------------------------------- apply
     def matvec(self, x: VectorLike) -> VectorLike:
         """Forward apply with global-shape check
-        (ref ``LinearOperator.py:170-192``)."""
+        (ref ``LinearOperator.py:170-192``). Opens a diagnostics span
+        (``PYLOPS_MPI_TPU_TRACE``) tagged with the operator class,
+        shape, dtype and mesh axes; compositions nest naturally."""
         M, N = self.shape
         if isinstance(x, DistributedArray) and x.global_shape != (N,):
             raise ValueError(
                 f"dimension mismatch: operator {self.shape}, x {x.global_shape}")
-        return self._matvec(x)
+        from .diagnostics import trace
+        with trace.op_span(self, "matvec"):
+            return self._matvec(x)
 
     def rmatvec(self, x: VectorLike) -> VectorLike:
         """Adjoint apply with global-shape check
-        (ref ``LinearOperator.py:206-230``)."""
+        (ref ``LinearOperator.py:206-230``). Traced like
+        :meth:`matvec`."""
         M, N = self.shape
         if isinstance(x, DistributedArray) and x.global_shape != (M,):
             raise ValueError(
                 f"dimension mismatch: operator {self.shape}, x {x.global_shape}")
-        return self._rmatvec(x)
+        from .diagnostics import trace
+        with trace.op_span(self, "rmatvec"):
+            return self._rmatvec(x)
 
     def _wrap_local(self, y, x: "DistributedArray", n: int):
         out = DistributedArray(global_shape=n, mesh=x.mesh,

@@ -271,13 +271,23 @@ class _MPISummaMatrixMult(_MatMulBase):
             raise ValueError(f"schedule={schedule!r}: expected "
                              "'auto', 'gather' or 'stat_a'")
         if schedule == "auto":
-            # per-device elements received per forward apply
-            vol_gather = ((self.Np // pr) * self.Kp_c * (pc - 1) / pc
-                          + self.Kp_r * (self.Mp // pc) * (pr - 1) / pr)
-            vol_stat_a = (self.Kp_r * (self.Mp // pc) * (pr - 1) / pr
-                          + self.Kp_r * self.Mp * (pc - 1) / pc
-                          + (self.Np // pr) * self.Mp * (pc - 1) / pc)
-            schedule = "stat_a" if vol_stat_a < vol_gather else "gather"
+            # per-device elements received per forward apply — the
+            # comm-volume model now lives in diagnostics/costmodel.py
+            # (shared with the roofline/bench layer; previously
+            # private to this auto-select)
+            from ..diagnostics.costmodel import summa_comm_volume
+            vols = summa_comm_volume(self.N, self.K, self.M, self.grid)
+            schedule = ("stat_a" if vols["stat_a"] < vols["gather"]
+                        else "gather")
+            # structured twin of the (previously undocumented)
+            # selection decision: lands in the trace JSONL artifact
+            from ..diagnostics import trace
+            trace.event("summa.schedule_select", cat="schedule",
+                        schedule=schedule, grid=self.grid,
+                        shape=(self.N, self.K, self.M),
+                        vol_gather=vols["gather"],
+                        vol_stat_a=vols["stat_a"],
+                        overlap=self.overlap)
         self.schedule = schedule
         # pad + tile A once, eagerly, and commit it to the 2-D mesh:
         # padding inside the traced apply would make XLA constant-fold a

@@ -52,6 +52,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from ..jaxcompat import shard_map
+from ..diagnostics import trace as _trace
 
 __all__ = [
     "all_to_all_resharding",
@@ -103,8 +104,13 @@ def all_to_all_resharding(x: jax.Array, mesh: Mesh,
         return lax.all_to_all(xs, axis_name, split_axis=new_axis,
                               concat_axis=old_axis, tiled=True)
 
-    return shard_map(kernel, mesh=mesh, in_specs=P(*in_spec),
-                     out_specs=P(*out_spec))(x)
+    with _trace.span("collective.all_to_all_resharding", cat="collective",
+                     shape=x.shape, dtype=x.dtype, old_axis=old_axis,
+                     new_axis=new_axis, n_dev=n_dev,
+                     ici_bytes=int(x.size * x.dtype.itemsize
+                                   * (n_dev - 1) / max(n_dev, 1))):
+        return shard_map(kernel, mesh=mesh, in_specs=P(*in_spec),
+                         out_specs=P(*out_spec))(x)
 
 
 def plane_all_to_all(br: jax.Array, bi: jax.Array, axis_name: str, *,
@@ -126,10 +132,14 @@ def plane_all_to_all(br: jax.Array, bi: jax.Array, axis_name: str, *,
     ``split_axis``/``concat_axis`` refer to the UNSTACKED plane axes
     (both must be < ``br.ndim``). Returns the transposed plane pair.
     """
-    s = jnp.stack([br, bi], axis=-1)
-    s = lax.all_to_all(s, axis_name, split_axis=split_axis,
-                       concat_axis=concat_axis, tiled=True)
-    return s[..., 0], s[..., 1]
+    with _trace.span("collective.plane_all_to_all", cat="collective",
+                     shape=br.shape, dtype=br.dtype,
+                     split_axis=split_axis, concat_axis=concat_axis,
+                     axis=axis_name):
+        s = jnp.stack([br, bi], axis=-1)
+        s = lax.all_to_all(s, axis_name, split_axis=split_axis,
+                           concat_axis=concat_axis, tiled=True)
+        return s[..., 0], s[..., 1]
 
 
 def cart_halo_extend(block: jax.Array, axis_name: str,
@@ -164,6 +174,10 @@ def cart_halo_extend(block: jax.Array, axis_name: str,
     g_ax = int(grid[ax])
     if hm == 0 and hp == 0:
         return block
+    _trace.event("collective.cart_halo_extend", cat="collective",
+                 shape=getattr(block, "shape", None),
+                 dtype=getattr(block, "dtype", None), axis=axis_name,
+                 grid=tuple(int(g) for g in grid), ax=ax, hm=hm, hp=hp)
     if g_ax == 1:
         padw = [(0, 0)] * block.ndim
         padw[a_ax] = (hm, hp)
@@ -243,17 +257,21 @@ def ring_pass(block, axis_name: str, n_shards: int, body: Callable,
     ``n_shards`` ``body`` calls (the ``assert_ring_schedule`` pin,
     ``utils/hlo.py``)."""
     n = int(n_shards)
-    i = lax.axis_index(axis_name)
-    perm = [(r, (r - shift) % n) for r in range(n)]
-    acc = init
-    resident = block
-    for s in range(n):
-        nxt = (lax.ppermute(resident, axis_name, perm)
-               if s < n - 1 else None)
-        owner = (i + s * shift) % n if n > 1 else i
-        acc = body(acc, resident, owner, s)
-        resident = nxt
-    return acc
+    with _trace.span("collective.ring_pass", cat="collective",
+                     shape=getattr(block, "shape", None),
+                     dtype=getattr(block, "dtype", None), axis=axis_name,
+                     n_shards=n, shift=shift, hops=n - 1):
+        i = lax.axis_index(axis_name)
+        perm = [(r, (r - shift) % n) for r in range(n)]
+        acc = init
+        resident = block
+        for s in range(n):
+            nxt = (lax.ppermute(resident, axis_name, perm)
+                   if s < n - 1 else None)
+            owner = (i + s * shift) % n if n > 1 else i
+            acc = body(acc, resident, owner, s)
+            resident = nxt
+        return acc
 
 
 def ring_halo_ghosts(block, axis_name: str, n_shards: int,
@@ -272,17 +290,21 @@ def ring_halo_ghosts(block, axis_name: str, n_shards: int,
     (``ops/derivatives.py`` overlap path). ``None`` is returned for a
     zero-width side."""
     n = int(n_shards)
-    gf = gb = None
-    if front:
-        start = jnp.maximum(valid_len - front, 0)
-        slab = lax.dynamic_slice_in_dim(block, start, front, axis=ax)
-        gf = lax.ppermute(slab, axis_name,
-                          [(r, r + 1) for r in range(n - 1)])
-    if back:
-        slab = lax.slice_in_dim(block, 0, back, axis=ax)
-        gb = lax.ppermute(slab, axis_name,
-                          [(r, r - 1) for r in range(1, n)])
-    return gf, gb
+    with _trace.span("collective.ring_halo_ghosts", cat="collective",
+                     shape=getattr(block, "shape", None),
+                     dtype=getattr(block, "dtype", None), axis=axis_name,
+                     n_shards=n, front=front, back=back, ax=ax):
+        gf = gb = None
+        if front:
+            start = jnp.maximum(valid_len - front, 0)
+            slab = lax.dynamic_slice_in_dim(block, start, front, axis=ax)
+            gf = lax.ppermute(slab, axis_name,
+                              [(r, r + 1) for r in range(n - 1)])
+        if back:
+            slab = lax.slice_in_dim(block, 0, back, axis=ax)
+            gb = lax.ppermute(slab, axis_name,
+                              [(r, r - 1) for r in range(1, n)])
+        return gf, gb
 
 
 def resolve_chunks(width: int, n_shards: int, chunks: int,
@@ -303,6 +325,12 @@ def resolve_chunks(width: int, n_shards: int, chunks: int,
             "%s: comm_chunks=%d does not fit an axis of length %d over "
             "%d shards; falling back to %d chunk(s)",
             where, chunks, width, n_shards, cap)
+        # structured twin of the log line: lands in the trace JSONL
+        # artifact instead of scrolling away on stdout
+        _trace.event("collective.resolve_chunks_fallback",
+                     cat="fallback", where=where, requested=chunks,
+                     width=int(width), n_shards=int(n_shards),
+                     resolved=cap)
         return cap
     return chunks
 
@@ -333,20 +361,25 @@ def chunked_pencil_transpose(b, axis_name: str, n_shards: int,
     K = int(chunks)
     tile = K * int(n_shards)
     bo = -(-b.shape[out_ax] // tile)
-    b = _pad_axis_to(b, out_ax, tile * bo)
-    cw = n_shards * bo  # chunk width, divisible by the mesh size
-    outs = []
-    for k in range(K):
-        ck = lax.slice_in_dim(b, k * cw, (k + 1) * cw, axis=out_ax)
-        if n_shards > 1:
-            ck = lax.all_to_all(ck, axis_name, split_axis=out_ax,
-                                concat_axis=0, tiled=True)
-        ck = mid(ck)
-        if n_shards > 1:
-            ck = lax.all_to_all(ck, axis_name, split_axis=0,
-                                concat_axis=out_ax, tiled=True)
-        outs.append(ck)
-    return jnp.concatenate(outs, axis=out_ax) if K > 1 else outs[0]
+    with _trace.span("collective.chunked_pencil_transpose",
+                     cat="collective", shape=b.shape, dtype=b.dtype,
+                     axis=axis_name, n_shards=int(n_shards),
+                     out_ax=out_ax, chunks=K,
+                     a2a_per_transpose=K * (2 if n_shards > 1 else 0)):
+        b = _pad_axis_to(b, out_ax, tile * bo)
+        cw = n_shards * bo  # chunk width, divisible by the mesh size
+        outs = []
+        for k in range(K):
+            ck = lax.slice_in_dim(b, k * cw, (k + 1) * cw, axis=out_ax)
+            if n_shards > 1:
+                ck = lax.all_to_all(ck, axis_name, split_axis=out_ax,
+                                    concat_axis=0, tiled=True)
+            ck = mid(ck)
+            if n_shards > 1:
+                ck = lax.all_to_all(ck, axis_name, split_axis=0,
+                                    concat_axis=out_ax, tiled=True)
+            outs.append(ck)
+        return jnp.concatenate(outs, axis=out_ax) if K > 1 else outs[0]
 
 
 def chunked_pencil_transpose_planes(br, bi, axis_name: str,
@@ -359,26 +392,31 @@ def chunked_pencil_transpose_planes(br, bi, axis_name: str,
     K = int(chunks)
     tile = K * int(n_shards)
     bo = -(-br.shape[out_ax] // tile)
-    br = _pad_axis_to(br, out_ax, tile * bo)
-    bi = _pad_axis_to(bi, out_ax, tile * bo)
-    cw = n_shards * bo
-    outs_r, outs_i = [], []
-    for k in range(K):
-        cr = lax.slice_in_dim(br, k * cw, (k + 1) * cw, axis=out_ax)
-        ci = lax.slice_in_dim(bi, k * cw, (k + 1) * cw, axis=out_ax)
-        if n_shards > 1:
-            cr, ci = plane_all_to_all(cr, ci, axis_name,
-                                      split_axis=out_ax, concat_axis=0)
-        cr, ci = mid(cr, ci)
-        if n_shards > 1:
-            cr, ci = plane_all_to_all(cr, ci, axis_name, split_axis=0,
-                                      concat_axis=out_ax)
-        outs_r.append(cr)
-        outs_i.append(ci)
-    if K > 1:
-        return (jnp.concatenate(outs_r, axis=out_ax),
-                jnp.concatenate(outs_i, axis=out_ax))
-    return outs_r[0], outs_i[0]
+    with _trace.span("collective.chunked_pencil_transpose_planes",
+                     cat="collective", shape=br.shape, dtype=br.dtype,
+                     axis=axis_name, n_shards=int(n_shards),
+                     out_ax=out_ax, chunks=K, planar=True):
+        br = _pad_axis_to(br, out_ax, tile * bo)
+        bi = _pad_axis_to(bi, out_ax, tile * bo)
+        cw = n_shards * bo
+        outs_r, outs_i = [], []
+        for k in range(K):
+            cr = lax.slice_in_dim(br, k * cw, (k + 1) * cw, axis=out_ax)
+            ci = lax.slice_in_dim(bi, k * cw, (k + 1) * cw, axis=out_ax)
+            if n_shards > 1:
+                cr, ci = plane_all_to_all(cr, ci, axis_name,
+                                          split_axis=out_ax,
+                                          concat_axis=0)
+            cr, ci = mid(cr, ci)
+            if n_shards > 1:
+                cr, ci = plane_all_to_all(cr, ci, axis_name, split_axis=0,
+                                          concat_axis=out_ax)
+            outs_r.append(cr)
+            outs_i.append(ci)
+        if K > 1:
+            return (jnp.concatenate(outs_r, axis=out_ax),
+                    jnp.concatenate(outs_i, axis=out_ax))
+        return outs_r[0], outs_i[0]
 
 
 def ring_halo_extend(block, axis_name: str, n_shards: int,

@@ -35,6 +35,7 @@ from jax import lax
 
 from ..distributedarray import DistributedArray
 from ..stacked import StackedDistributedArray
+from ..diagnostics import telemetry, trace as _trace
 
 __all__ = ["CG", "CGLS", "cg", "cgls", "clear_fused_cache"]
 
@@ -147,6 +148,7 @@ class CG(_BaseSolver):
         self.kold = k
         self.iiter += 1
         self.cost.append(jnp.sqrt(self.kold))
+        telemetry.iteration("cg", self.iiter, resid=jnp.sqrt(k), k=k)
         if show:
             self._print_step(x)
         return x
@@ -229,6 +231,8 @@ class CGLS(_BaseSolver):
         self.cost.append(jnp.asarray(self.s.norm()))
         self.cost1.append(jnp.sqrt(self.cost[self.iiter] ** 2
                                    + self.damp * _abs(x.dot(x.conj()))))
+        telemetry.iteration("cgls", self.iiter,
+                            resid=self.cost[self.iiter], k=k)
         if show:
             self._print_step(x)
         return x
@@ -305,6 +309,9 @@ def _cg_fused(Op, y: Vector, x0: Vector, tol, *, niter: int):
         c = r + c * _step_scalar(b, xdt)
         iiter = iiter + 1
         cost = lax.dynamic_update_index_in_dim(cost, jnp.sqrt(k), iiter, 0)
+        # no-op unless telemetry is enabled (PYLOPS_MPI_TPU_TRACE=full):
+        # disabled builds trace NOTHING here — the zero-host-callback pin
+        telemetry.iteration("cg", iiter, resid=jnp.sqrt(k), k=k, alpha=a)
         return (x, r, c, k, iiter, cost)
 
     def cond(state):
@@ -345,6 +352,8 @@ def _cgls_fused(Op, y: Vector, x0: Vector, damp, tol, *, niter: int):
         cost = lax.dynamic_update_index_in_dim(cost, sn, iiter, 0)
         r2 = jnp.sqrt(sn ** 2 + damp2 * _rdot(x, x))
         cost1 = lax.dynamic_update_index_in_dim(cost1, r2, iiter, 0)
+        # no-op unless telemetry is enabled (see _cg_fused note)
+        telemetry.iteration("cgls", iiter, resid=sn, k=k, alpha=a)
         return (x, s, c, q, k, iiter, cost, cost1)
 
     def cond(state):
@@ -397,6 +406,8 @@ def _cgls_fused_normal(Op, y: Vector, x0: Vector, damp, tol, *, niter: int):
         cost = lax.dynamic_update_index_in_dim(cost, sn, iiter, 0)
         r2 = jnp.sqrt(sn ** 2 + damp2 * _rdot(x, x))
         cost1 = lax.dynamic_update_index_in_dim(cost1, r2, iiter, 0)
+        # no-op unless telemetry is enabled (see _cg_fused note)
+        telemetry.iteration("cgls", iiter, resid=sn, k=k, alpha=a)
         return (x, s, r, c, k, iiter, cost, cost1)
 
     def cond(state):
@@ -472,7 +483,10 @@ def _get_fused(Op, key, make_builder, donate_argnums=()):
     from ..linearoperator import operator_is_jit_arg
     from ..ops._precision import donation_enabled
     donate = tuple(donate_argnums) if donation_enabled() else ()
-    key = key + (donate,)
+    # telemetry state is compile-relevant: a program traced with the
+    # in-loop debug callbacks embedded must never be reused when the
+    # gate is off (and vice versa) — same pattern as the donation gate
+    key = key + (donate, telemetry.telemetry_signature())
     entry = _FUSED_CACHE.get(key)
     if entry is None:
         if operator_is_jit_arg(Op):
@@ -515,18 +529,23 @@ def cg(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
     if use_fused and (callback is not None or show):
         raise ValueError("fused=True cannot honor callback/show; use "
                          "fused=False for per-iteration hooks")
-    if use_fused:
-        fn = _get_fused(Op, (id(Op), "cg", niter, _vkey(y), _vkey(x0)),
-                        lambda op: partial(_cg_fused, op, niter=niter),
-                        donate_argnums=_DONATE_X0)
-        x, iiter, cost = fn(y, x0 if x0_owned else _donate_copy(x0), tol)
-        iiter = int(iiter)
-        return x, iiter, np.asarray(cost)[:iiter + 1]
-    solver = CG(Op)
-    solver._callback_wrap(callback)
-    x, iiter, cost = solver.solve(y, x0, niter=niter, tol=tol, show=show,
-                                  itershow=itershow)
-    return x, iiter, cost
+    with _trace.span("solver.cg", cat="solver", op=type(Op).__name__,
+                     shape=Op.shape, dtype=_vdtype(x0), niter=niter,
+                     tol=tol, fused=use_fused,
+                     telemetry=telemetry.telemetry_enabled()):
+        if use_fused:
+            fn = _get_fused(Op, (id(Op), "cg", niter, _vkey(y), _vkey(x0)),
+                            lambda op: partial(_cg_fused, op, niter=niter),
+                            donate_argnums=_DONATE_X0)
+            x, iiter, cost = fn(y, x0 if x0_owned else _donate_copy(x0),
+                                tol)
+            iiter = int(iiter)
+            return x, iiter, np.asarray(cost)[:iiter + 1]
+        solver = CG(Op)
+        solver._callback_wrap(callback)
+        x, iiter, cost = solver.solve(y, x0, niter=niter, tol=tol,
+                                      show=show, itershow=itershow)
+        return x, iiter, cost
 
 
 def cgls(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
@@ -550,23 +569,28 @@ def cgls(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
     if use_normal and not use_fused:
         raise ValueError("normal=True requires the fused path; drop "
                          "callback/show or pass fused=True")
-    if use_fused:
-        builder = _cgls_fused_normal if use_normal else _cgls_fused
-        fn = _get_fused(Op, (id(Op), "cgls", use_normal, niter, _vkey(y),
-                             _vkey(x0)),
-                        lambda op: partial(builder, op, niter=niter),
-                        donate_argnums=_DONATE_X0)
-        x, iiter, cost, cost1, kold = fn(
-            y, x0 if x0_owned else _donate_copy(x0), damp, tol)
-        iiter = int(iiter)
-        istop = 1 if float(jnp.max(kold)) < tol else 2
-        cost = np.asarray(cost)[:iiter + 1]
-        cost1 = np.asarray(cost1)[:iiter + 1]
-        return x, istop, iiter, kold, cost1[-1], cost
-    solver = CGLS(Op)
-    solver._callback_wrap(callback)
-    return solver.solve(y, x0, niter=niter, damp=damp, tol=tol, show=show,
-                        itershow=itershow)
+    with _trace.span("solver.cgls", cat="solver", op=type(Op).__name__,
+                     shape=Op.shape, dtype=_vdtype(x0), niter=niter,
+                     damp=damp, tol=tol, fused=use_fused,
+                     normal=use_normal,
+                     telemetry=telemetry.telemetry_enabled()):
+        if use_fused:
+            builder = _cgls_fused_normal if use_normal else _cgls_fused
+            fn = _get_fused(Op, (id(Op), "cgls", use_normal, niter,
+                                 _vkey(y), _vkey(x0)),
+                            lambda op: partial(builder, op, niter=niter),
+                            donate_argnums=_DONATE_X0)
+            x, iiter, cost, cost1, kold = fn(
+                y, x0 if x0_owned else _donate_copy(x0), damp, tol)
+            iiter = int(iiter)
+            istop = 1 if float(jnp.max(kold)) < tol else 2
+            cost = np.asarray(cost)[:iiter + 1]
+            cost1 = np.asarray(cost1)[:iiter + 1]
+            return x, istop, iiter, kold, cost1[-1], cost
+        solver = CGLS(Op)
+        solver._callback_wrap(callback)
+        return solver.solve(y, x0, niter=niter, damp=damp, tol=tol,
+                            show=show, itershow=itershow)
 
 
 def _vkey(v: Vector):

@@ -41,6 +41,7 @@ from jax import lax
 
 from ..distributedarray import DistributedArray
 from ..stacked import StackedDistributedArray
+from ..diagnostics import telemetry, trace as _trace
 from .eigs import power_iteration
 
 __all__ = ["ISTA", "FISTA", "ista", "fista"]
@@ -330,6 +331,10 @@ def _ista_fused(Op, y: Vector, x0: Vector, alpha, eps, tol, decay,
         xupdate = jnp.max(jnp.asarray((xnew - x).norm())).astype(rdt)
         cost = lax.dynamic_update_index_in_dim(
             cost, (costdata + costreg).astype(cost.dtype), iiter, 0)
+        # no-op unless telemetry is enabled (PYLOPS_MPI_TPU_TRACE=full)
+        # — the disabled build traces NOTHING here (zero-callback pin)
+        telemetry.iteration("fista" if momentum else "ista", iiter + 1,
+                            cost=costdata + costreg, xupdate=xupdate)
         return (_relayout_like(x, xnew), _relayout_like(z, znew), tnew,
                 iiter + 1, cost, xupdate)
 
@@ -402,23 +407,28 @@ def ista(Op, y: Vector, x0: Optional[Vector] = None,
     callback/show/monitorres, runs the fused on-device loop."""
     use_fused = fused if fused is not None else \
         (callback is None and not show and not monitorres and perc is None)
-    if use_fused:
-        if callback is not None or show or monitorres:
-            raise ValueError("fused=True cannot honor callback/show/"
-                             "monitorres; use fused=False for hooks")
-        if perc is not None:
-            raise NotImplementedError(
-                "percentile thresholding is not implemented")
-        return _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha,
-                                   eigsdict, tol, threshkind, decay,
-                                   momentum=False)
-    solver = ISTA(Op)
-    if callback is not None:
-        solver.callback = callback
-    return solver.solve(y, x0, niter=niter, SOp=SOp, eps=eps, alpha=alpha,
-                        eigsdict=eigsdict, tol=tol, threshkind=threshkind,
-                        perc=perc, decay=decay, monitorres=monitorres,
-                        show=show, itershow=itershow)
+    with _trace.span("solver.ista", cat="solver", op=type(Op).__name__,
+                     shape=Op.shape, niter=niter, eps=eps,
+                     threshkind=threshkind, fused=use_fused,
+                     telemetry=telemetry.telemetry_enabled()):
+        if use_fused:
+            if callback is not None or show or monitorres:
+                raise ValueError("fused=True cannot honor callback/show/"
+                                 "monitorres; use fused=False for hooks")
+            if perc is not None:
+                raise NotImplementedError(
+                    "percentile thresholding is not implemented")
+            return _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha,
+                                       eigsdict, tol, threshkind, decay,
+                                       momentum=False)
+        solver = ISTA(Op)
+        if callback is not None:
+            solver.callback = callback
+        return solver.solve(y, x0, niter=niter, SOp=SOp, eps=eps,
+                            alpha=alpha, eigsdict=eigsdict, tol=tol,
+                            threshkind=threshkind, perc=perc, decay=decay,
+                            monitorres=monitorres, show=show,
+                            itershow=itershow)
 
 
 def fista(Op, y: Vector, x0: Optional[Vector] = None,
@@ -431,20 +441,25 @@ def fista(Op, y: Vector, x0: Optional[Vector] = None,
     no callback/show/monitorres, runs the fused on-device loop."""
     use_fused = fused if fused is not None else \
         (callback is None and not show and not monitorres and perc is None)
-    if use_fused:
-        if callback is not None or show or monitorres:
-            raise ValueError("fused=True cannot honor callback/show/"
-                             "monitorres; use fused=False for hooks")
-        if perc is not None:
-            raise NotImplementedError(
-                "percentile thresholding is not implemented")
-        return _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha,
-                                   eigsdict, tol, threshkind, decay,
-                                   momentum=True)
-    solver = FISTA(Op)
-    if callback is not None:
-        solver.callback = callback
-    return solver.solve(y, x0, niter=niter, SOp=SOp, eps=eps, alpha=alpha,
-                        eigsdict=eigsdict, tol=tol, threshkind=threshkind,
-                        perc=perc, decay=decay, monitorres=monitorres,
-                        show=show, itershow=itershow)
+    with _trace.span("solver.fista", cat="solver", op=type(Op).__name__,
+                     shape=Op.shape, niter=niter, eps=eps,
+                     threshkind=threshkind, fused=use_fused,
+                     telemetry=telemetry.telemetry_enabled()):
+        if use_fused:
+            if callback is not None or show or monitorres:
+                raise ValueError("fused=True cannot honor callback/show/"
+                                 "monitorres; use fused=False for hooks")
+            if perc is not None:
+                raise NotImplementedError(
+                    "percentile thresholding is not implemented")
+            return _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha,
+                                       eigsdict, tol, threshkind, decay,
+                                       momentum=True)
+        solver = FISTA(Op)
+        if callback is not None:
+            solver.callback = callback
+        return solver.solve(y, x0, niter=niter, SOp=SOp, eps=eps,
+                            alpha=alpha, eigsdict=eigsdict, tol=tol,
+                            threshkind=threshkind, perc=perc, decay=decay,
+                            monitorres=monitorres, show=show,
+                            itershow=itershow)

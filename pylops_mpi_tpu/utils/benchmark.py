@@ -10,6 +10,12 @@ all MPI ranks and device-syncs CUDA before each ``perf_counter``
 XLA-level inspection. Disabled globally by ``BENCH_PYLOPS_MPI=0``
 (ref ``benchmark.py:25``; the same kill-switch name is honoured, plus
 ``BENCH_PYLOPS_MPI_TPU``).
+
+This is the reference-parity MANUAL timing decorator. For the
+always-on structured tracing layer (env-gated spans wired through
+every operator/collective/solver, Chrome-trace JSONL artifacts,
+in-loop solver telemetry), see :mod:`pylops_mpi_tpu.diagnostics` and
+``docs/observability.md``.
 """
 
 from __future__ import annotations

@@ -39,6 +39,12 @@ backend — XLA collectives — so the seam carries different switches:
   streamed pencil transposes when the overlap is enabled; per-operator
   ``comm_chunks=`` wins. Chunk counts that don't fit the axis fall
   back (logged) instead of erroring.
+- ``PYLOPS_MPI_TPU_TRACE`` / ``PYLOPS_MPI_TPU_TELEMETRY`` /
+  ``PYLOPS_MPI_TPU_TRACE_FILE`` / ``PYLOPS_MPI_TPU_PROFILE_DIR``: the
+  observability seams (round 9) — structured span tracing, in-loop
+  solver telemetry and ``jax.profiler`` capture. Resolved by
+  :mod:`pylops_mpi_tpu.diagnostics` (see ``docs/observability.md``),
+  not here, so the jax-free scripts can read them standalone.
 """
 
 from __future__ import annotations

@@ -30,7 +30,9 @@ __all__ = ["collective_report", "assert_no_full_gather",
            "parse_hlo_collectives", "complex_dtype_lines",
            "assert_complex_free", "compiled_hlo", "count_ops",
            "assert_max_converts", "donation_report", "assert_donation",
-           "count_collectives", "assert_ring_schedule"]
+           "count_collectives", "assert_ring_schedule",
+           "host_callback_lines", "count_host_callbacks",
+           "assert_no_host_callbacks"]
 
 # HLO opcode -> canonical name; bytes counted from the result shape
 _COLLECTIVE_OPS = ("all-gather", "all-reduce", "all-to-all",
@@ -390,6 +392,45 @@ def assert_ring_schedule(fn, *args, steps: int, dots: Optional[int] = None,
                 f"{list(range(steps))}): the hops were issued in "
                 "parallel, not pipelined as a ring")
     return len(perms), n_dots
+
+
+_CALLBACK_RE = re.compile(
+    r'custom[-_]call[^\n]*custom_call_target="[^"]*callback[^"]*"',
+    re.IGNORECASE)
+
+
+def host_callback_lines(hlo: str) -> list:
+    """Every HLO line whose instruction is a host-callback custom-call
+    (``xla_python_cpu_callback`` / ``xla_ffi_python_cpu_callback`` /
+    GPU variants — anything whose ``custom_call_target`` mentions
+    ``callback``): the compiled footprint of ``jax.debug.callback`` /
+    ``io_callback`` / ``pure_callback``."""
+    return [ln for ln in hlo.splitlines() if _CALLBACK_RE.search(ln)]
+
+
+def count_host_callbacks(fn, *args, **kwargs) -> int:
+    """Compile ``fn(*args, **kwargs)`` and count host-callback
+    custom-calls in the optimized HLO."""
+    return len(host_callback_lines(compiled_hlo(fn, *args, **kwargs)))
+
+
+def assert_no_host_callbacks(fn, *args, **kwargs) -> str:
+    """Compile and raise ``AssertionError`` if the program contains ANY
+    host-callback custom-call — the telemetry-off pin for the fused
+    solver loops (``diagnostics/telemetry.py``): with
+    ``PYLOPS_MPI_TPU_TRACE≠full`` the donated/fused hot path must
+    compile to a program with zero host round-trips, bit-identical to
+    the pre-diagnostics build. Returns the HLO text for further
+    checks."""
+    hlo = compiled_hlo(fn, *args, **kwargs)
+    lines = host_callback_lines(hlo)
+    if lines:
+        head = "\n".join(ln.strip()[:160] for ln in lines[:8])
+        raise AssertionError(
+            f"program contains {len(lines)} host-callback custom-call "
+            f"line(s) — telemetry/debug callbacks leaked into a build "
+            f"that should be callback-free; first few:\n{head}")
+    return hlo
 
 
 def assert_no_full_gather(fn, *args, max_fraction: float = 0.5, **kwargs):
