@@ -48,9 +48,9 @@ sys.path.insert(0, _HERE)  # for tpu_probe_loop.rehearse_env
 # literals that used to be duplicated inline here); the fallback only
 # covers a missing/broken table
 _FALLBACK_BUDGETS = {
-    "selfcheck": 600, "flagship_small": 600, "fft_planar": 600,
-    "overlap": 600, "breakdown": 700, "diag": 700, "flagship_mid": 1200,
-    "flagship_full": 2400,
+    "selfcheck": 600, "tune": 240, "flagship_small": 600,
+    "fft_planar": 600, "overlap": 600, "breakdown": 700, "diag": 700,
+    "flagship_mid": 1200, "flagship_full": 2400,
 }
 
 

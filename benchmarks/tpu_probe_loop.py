@@ -103,7 +103,7 @@ def _budget(stage: str, rehearse: bool = False) -> int:
     (``pylops_mpi_tpu/diagnostics/profiler.py``; env overrides via the
     historical ``PROBE_*_TIMEOUT`` names), with the pre-round-9
     literals as a last-resort fallback."""
-    _FALLBACK = {"selfcheck": 900, "flagship_small": 900,
+    _FALLBACK = {"selfcheck": 900, "tune": 600, "flagship_small": 900,
                  "fft_planar": 700, "flagship_full": 3000,
                  "flagship_mid": 1200, "overlap": 600, "bisect": 1200,
                  "breakdown": 900, "diag": 900}
@@ -124,6 +124,27 @@ def probe(timeout: int = 120) -> tuple:
 def _stage_selfcheck(env, timeout):
     return _bench_mod()._run_json_cmd(
         [sys.executable, os.path.join(_HERE, "tpu_selfcheck.py")], env,
+        timeout=timeout, cwd=_ROOT)
+
+
+def _stage_tune(env, timeout):
+    """Autotuning sweep (round 10): ``python -m pylops_mpi_tpu.tuning
+    --ladder`` measures the flagship plan spaces and banks the winners
+    into the plan cache, so every LATER stage of this window (and
+    every later session with ``PYLOPS_MPI_TPU_TUNE=on``) replays
+    measured plans for free. Runs EARLY — right after the kernel
+    validity verdict — because a mis-tuned flagship wastes far more of
+    the window than the sweep costs; the ladder flag sizes the shapes
+    by platform (quick on the CPU rehearsal)."""
+    env = dict(env)
+    # bank into the probe dir when one is set (rehearsals stay
+    # disposable; real windows persist next to the stage cache)
+    env.setdefault("PYLOPS_MPI_TPU_TUNE_CACHE",
+                   os.path.join(env.get("TPU_PROBE_DIR", _ROOT),
+                                "tpu_tune_cache.json"))
+    return _bench_mod()._run_json_cmd(
+        [sys.executable, "-m", "pylops_mpi_tpu.tuning", "--ladder",
+         "--out", env["PYLOPS_MPI_TPU_TUNE_CACHE"]], env,
         timeout=timeout, cwd=_ROOT)
 
 
@@ -312,6 +333,10 @@ def harvest(cache: dict, rehearse: bool = False,
         # (breakdown/diag) get a chance to eat the window. flagship_mid
         # stays as the consolation headline if full dies mid-stage.
         ("selfcheck", lambda t: _stage_selfcheck(env, t)),
+        # tune sits right after the validity verdict (round 10): bank
+        # measured plans BEFORE the flagship stages so they (and every
+        # later session) replay them instead of guessing
+        ("tune", lambda t: _stage_tune(env, t)),
         ("flagship_small", lambda t: _stage_flagship(env, "small", t)),
         ("fft_planar", lambda t: _stage_fft_planar(env, t)),
         ("flagship_full", lambda t: _stage_flagship(env, "full", t)),

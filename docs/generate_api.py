@@ -82,7 +82,7 @@ PAGES = {
     "utils": [
         ("Testing", "pylops_mpi_tpu.utils.dottest", ["dottest"]),
         ("Benchmarking / profiling", "pylops_mpi_tpu.utils.benchmark",
-         ["benchmark", "mark", "profile_trace"]),
+         ["benchmark", "mark", "profile_trace", "time_callable"]),
         ("Collective-schedule inspection", "pylops_mpi_tpu.utils.hlo",
          ["collective_report", "assert_no_full_gather",
           "parse_hlo_collectives", "count_collectives",
@@ -120,6 +120,21 @@ PAGES = {
          ["stage_budget", "DeadlineRunner", "profile_capture",
           "profile_dir"]),
     ],
+    "tuning": [
+        ("Plan seam", "pylops_mpi_tpu.tuning.plan",
+         ["Plan", "get_plan", "tune_mode", "tune_enabled", "plan_key",
+          "shape_bucket", "chunk_hint", "record_chunk_plan",
+          "applied_provenance"]),
+        ("Tuning spaces", "pylops_mpi_tpu.tuning.space",
+         ["Axis", "TuningSpace", "register_space", "space_for",
+          "candidates", "rank", "default_params"]),
+        ("Measured search", "pylops_mpi_tpu.tuning.search",
+         ["measure_candidates", "tune_budget_s", "tune_topk",
+          "tune_margin"]),
+        ("Plan cache", "pylops_mpi_tpu.tuning.cache",
+         ["cache_path", "lookup", "store", "load_plans",
+          "clear_memory"]),
+    ],
     "models": [
         ("Model workflows", "pylops_mpi_tpu.models",
          ["PoststackLinearModelling", "MPIPoststackLinearModelling",
@@ -138,6 +153,7 @@ PAGE_TITLES = {
     "local": "Local operators and kernels",
     "utils": "Utilities",
     "diagnostics": "Diagnostics and observability",
+    "tuning": "Autotuning",
     "models": "Model workflows",
 }
 

@@ -469,7 +469,8 @@ def _bind_builtin() -> None:
 
 
 # ---------------------------------------------------------------- roofline
-def roofline(cost: OpCost, peaks: Dict, n_dev: int = 1) -> Dict:
+def roofline(cost: OpCost, peaks: Dict, n_dev: int = 1,
+             measured_s: Optional[float] = None) -> Dict:
     """Place an :class:`OpCost` on the roofline: per-component times
     (``flops / peak_flops``, ``hbm_bytes / hbm_bw``, ``ici_bytes /
     ici_bw``; the cost is PER DEVICE, the peaks PER CHIP, so ``n_dev``
@@ -477,7 +478,17 @@ def roofline(cost: OpCost, peaks: Dict, n_dev: int = 1) -> Dict:
     available components (a perfectly-overlapped execution's lower
     bound), and ``bound`` = the component that dominates. Components
     whose peak is ``None``/0 are skipped — an unknown chip yields
-    ``predicted_s=None`` rather than a wrong roofline."""
+    ``predicted_s=None`` rather than a wrong roofline.
+
+    ``measured_s`` (optional): the measured per-apply seconds. When
+    the implied HBM bandwidth EXCEEDS the chip's HBM peak, the
+    working set cannot have streamed from HBM — it was VMEM-resident
+    — so the result re-buckets: ``regime="vmem"``, the HBM component
+    is dropped from the bound, and ``hbm_pct`` is never reported
+    above 100 (the VERDICT round-5 misattribution: 1261 GB/s
+    "measured" against an 819 GB/s v5e peak is a cache number, not an
+    HBM number). Otherwise ``regime="hbm"`` with the honest
+    ``hbm_pct``."""
     comps = {}
     if peaks.get("flops"):
         comps["compute"] = cost.flops / peaks["flops"]
@@ -489,7 +500,24 @@ def roofline(cost: OpCost, peaks: Dict, n_dev: int = 1) -> Dict:
         return {"predicted_s": None, "bound": None, "components_s": {},
                 "cost": cost.as_dict(), "n_dev": n_dev}
     bound = max(comps, key=comps.get)
-    return {"predicted_s": comps[bound], "bound": bound,
-            "components_s": {k: float(f"{v:.4g}")
-                             for k, v in comps.items()},
-            "cost": cost.as_dict(), "n_dev": n_dev}
+    out = {"predicted_s": comps[bound], "bound": bound,
+           "components_s": {k: float(f"{v:.4g}")
+                            for k, v in comps.items()},
+           "cost": cost.as_dict(), "n_dev": n_dev}
+    if measured_s and measured_s > 0 and peaks.get("hbm_gbps") \
+            and cost.hbm_bytes:
+        implied_gbps = cost.hbm_bytes / measured_s / 1e9
+        if implied_gbps > peaks["hbm_gbps"]:
+            out["regime"] = "vmem"
+            out["implied_hbm_gbps"] = round(implied_gbps, 1)
+            out["note"] = ("implied bandwidth exceeds the HBM peak: "
+                           "working set is VMEM-resident; not an HBM "
+                           "measurement")
+            nonhbm = {k: v for k, v in comps.items() if k != "hbm"}
+            if nonhbm:
+                out["bound"] = max(nonhbm, key=nonhbm.get)
+        else:
+            out["regime"] = "hbm"
+            out["hbm_pct"] = round(
+                100.0 * implied_gbps / peaks["hbm_gbps"], 1)
+    return out

@@ -50,6 +50,11 @@ __all__ = ["STAGE_BUDGETS", "stage_budget", "DeadlineRunner",
 # existing harvest configs keep working.
 STAGE_BUDGETS: Dict[str, Dict[str, Optional[int]]] = {
     "selfcheck":      {"tpu": 900,  "rehearse": 600},
+    # the autotuner sweep (python -m pylops_mpi_tpu.tuning): runs
+    # EARLY in the ladder so later stages replay measured plans; also
+    # the per-search budget tuning.search enforces in-process
+    # (PYLOPS_MPI_TPU_TUNE_BUDGET overrides for a single search)
+    "tune":           {"tpu": 600,  "rehearse": 240},
     "flagship_small": {"tpu": 900,  "rehearse": 600},
     "fft_planar":     {"tpu": 700,  "rehearse": 600},
     "flagship_full":  {"tpu": 3000, "rehearse": 2400},

@@ -63,11 +63,23 @@ class MPIBlockDiag(MPILinearOperator):
         (``PYLOPS_MPI_TPU_PRECISION``, ops/_precision.py) decides —
         under the ``bf16`` policy f32 block stacks store narrow
         automatically; pass an explicit dtype to override either way.
+    normal_path : str, optional
+        Which ``normal_matvec`` implementation to use: ``"fused"``
+        (the one-sweep Pallas/XLA-FFI kernel, when supported),
+        ``"two_sweep"`` (plain matvec+rmatvec), or ``None``/``"auto"``
+        (default) — fused when available, unless the autotuner
+        (``PYLOPS_MPI_TPU_TUNE=on|auto``) has a measured plan saying
+        otherwise. An explicit value always beats the tuner.
     """
 
     def __init__(self, ops: Sequence[LocalOperator],
                  mask: Optional[Sequence[int]] = None,
-                 mesh=None, dtype=None, compute_dtype=None):
+                 mesh=None, dtype=None, compute_dtype=None,
+                 normal_path: Optional[str] = None):
+        if normal_path not in (None, "auto", "fused", "two_sweep"):
+            raise ValueError(
+                f"normal_path={normal_path!r}: expected None, 'auto', "
+                "'fused' or 'two_sweep'")
         self.ops = list(ops)
         self.mask = tuple(mask) if mask is not None else None
         self.compute_dtype = compute_dtype
@@ -91,6 +103,24 @@ class MPIBlockDiag(MPILinearOperator):
             from ._precision import default_compute_dtype
             self.compute_dtype = default_compute_dtype(dtype)
         self._batched = self._try_batch()
+        # autotuner seam (round 10): the Pallas/XLA-FFI-vs-two-sweep
+        # normal-equation path. Only consulted for the default
+        # sentinel; PYLOPS_MPI_TPU_TUNE=off leaves _normal_path None
+        # (= fused when available — exactly today's behavior).
+        self._normal_path = None if normal_path == "auto" else normal_path
+        if self._normal_path is None and self._batched is not None:
+            from ..tuning import plan as _tuneplan
+            nblk, m, n = self._batched.shape
+            tplan = _tuneplan.get_plan(
+                "blockdiag", shape=self.shape, dtype=self.dtype,
+                mesh=self.mesh,
+                extra={"fused_available": bool(self.has_fused_normal),
+                       "a_bytes": float(
+                           nblk * m * n * self._batched.dtype.itemsize)})
+            if tplan is not None \
+                    and tplan.get("normal_path") in ("fused",
+                                                     "two_sweep"):
+                self._normal_path = tplan.get("normal_path")
 
     def _try_batch(self):
         """Homogeneous MatrixMult blocks → stacked batched GEMM, for
@@ -184,6 +214,8 @@ class MPIBlockDiag(MPILinearOperator):
     @property
     def has_fused_normal(self) -> bool:
         from .pallas_kernels import normal_matvec_supported
+        if getattr(self, "_normal_path", None) == "two_sweep":
+            return False  # forced (kwarg or tuned plan)
         if not (self._batched is not None
                 and self._batched_k == 1  # kernels are vector-form
                 and len(self.mesh.axis_names) == 1):  # shard_map is 1-D

@@ -129,10 +129,22 @@ class _StencilOperator(MPILinearOperator):
     def __init__(self, dims, mesh=None, dtype=None, overlap=None):
         from ..utils.deps import overlap_enabled
         self.dims_nd = _tuplize(dims)
-        self._overlap = overlap_enabled(overlap)
         n = int(np.prod(self.dims_nd))
         from ..parallel.mesh import default_mesh
         self.mesh = mesh if mesh is not None else default_mesh()
+        # autotuner seam (round 10): the ghost strategy (bulk
+        # halo-extend vs interior/boundary split) for a None overlap
+        # comes from the plan when PYLOPS_MPI_TPU_TUNE=on|auto;
+        # explicit kwargs/env pins always win, off is bit-identical
+        from ..utils.deps import overlap_env_pinned
+        if overlap is None and not overlap_env_pinned():
+            from ..tuning import plan as _tuneplan
+            tplan = _tuneplan.get_plan("derivative", shape=self.dims_nd,
+                                       dtype=dtype, mesh=self.mesh)
+            if tplan is not None \
+                    and tplan.get("overlap") in ("on", "off"):
+                overlap = tplan.get("overlap")
+        self._overlap = overlap_enabled(overlap)
         # output local shapes: balanced row split of axis 0, flattened
         # (what the reference's @reshaped produces)
         rows = local_split(self.dims_nd, int(self.mesh.devices.size),

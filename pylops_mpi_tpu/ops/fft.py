@@ -84,17 +84,8 @@ class _MPIBaseFFTND(MPILinearOperator):
                  real=False, ifftshift_before=False, fftshift_after=False,
                  mesh=None, dtype="complex128", overlap=None,
                  comm_chunks=None):
-        from ..utils.deps import overlap_enabled, comm_chunks_default
-        # pipelined pencil transposes (round 8): when the overlap is
-        # enabled the two aligned-path all-to-alls stream as
-        # `comm_chunks` tiled chunks interleaved with the per-chunk
-        # axis-0 transforms (collectives.chunked_pencil_transpose);
-        # off = the bulk single-collective schedule, bit-identical.
-        self._overlap = overlap_enabled(overlap)
         if comm_chunks is not None and int(comm_chunks) < 1:
             raise ValueError(f"comm_chunks={comm_chunks}: must be >= 1")
-        self._comm_chunks = (int(comm_chunks) if comm_chunks is not None
-                             else comm_chunks_default())
         self.dims_nd = tuple(int(d) for d in np.atleast_1d(dims))
         ndim = len(self.dims_nd)
         axes = tuple(ax % ndim for ax in np.atleast_1d(axes))
@@ -149,6 +140,36 @@ class _MPIBaseFFTND(MPILinearOperator):
         self.dimsd_nd = tuple(dimsd)
         from ..parallel.mesh import default_mesh
         self.mesh = mesh if mesh is not None else default_mesh()
+        # pipelined pencil transposes (round 8): when the overlap is
+        # enabled the two aligned-path all-to-alls stream as
+        # `comm_chunks` tiled chunks interleaved with the per-chunk
+        # axis-0 transforms (collectives.chunked_pencil_transpose);
+        # off = the bulk single-collective schedule, bit-identical.
+        # Autotuner seam (round 10): kwargs left at None consult the
+        # plan (PYLOPS_MPI_TPU_TUNE=on|auto); explicit kwargs and the
+        # env seams behave exactly as before when tuning is off.
+        from ..utils.deps import (overlap_enabled, comm_chunks_default,
+                                  overlap_env_pinned,
+                                  comm_chunks_env_pinned)
+        want_overlap = overlap is None and not overlap_env_pinned()
+        want_chunks = comm_chunks is None and not comm_chunks_env_pinned()
+        self._chunks_from_user = not want_chunks
+        if want_overlap or want_chunks:
+            from ..tuning import plan as _tuneplan
+            tplan = _tuneplan.get_plan(
+                "fft", shape=self.dims_nd, dtype=self.cdtype,
+                mesh=self.mesh,
+                extra={"fft_axes": tuple(int(a) for a in self.axes),
+                       "real": self.real})
+            if tplan is not None:
+                if want_overlap \
+                        and tplan.get("overlap") in ("on", "off"):
+                    overlap = tplan.get("overlap")
+                if want_chunks and tplan.get("comm_chunks"):
+                    comm_chunks = max(1, int(tplan.get("comm_chunks")))
+        self._overlap = overlap_enabled(overlap)
+        self._comm_chunks = (int(comm_chunks) if comm_chunks is not None
+                             else comm_chunks_default())
         self.dims = self.dims_nd
         self.dimsd = self.dimsd_nd
         super().__init__(shape=(int(np.prod(dimsd)), int(np.prod(self.dims_nd))),
@@ -205,7 +226,8 @@ class _MPIBaseFFTND(MPILinearOperator):
             return 1
         from ..parallel.collectives import resolve_chunks
         return resolve_chunks(width, P, self._comm_chunks,
-                              where=f"{type(self).__name__} pencil")
+                              where=f"{type(self).__name__} pencil",
+                              allow_plan=not self._chunks_from_user)
 
     def _shift_axes(self, flags) -> Tuple[int, ...]:
         return tuple(int(ax) for ax, f in zip(self.axes, flags) if f)

@@ -93,11 +93,22 @@ class MPIHalo(MPILinearOperator):
     def __init__(self, dims, halo, proc_grid_shape=None, mesh=None,
                  dtype=np.float64, overlap=None):
         from ..utils.deps import overlap_enabled
-        self._overlap = overlap_enabled(overlap)
         self.global_dims = tuple(int(d) for d in np.atleast_1d(dims))
         self.ndim = len(self.global_dims)
         from ..parallel.mesh import default_mesh
         self.mesh = mesh if mesh is not None else default_mesh()
+        # autotuner seam (round 10): None overlap consults the plan
+        # (inert when PYLOPS_MPI_TPU_TUNE=off); explicit kwargs and
+        # explicit env pins win
+        from ..utils.deps import overlap_env_pinned
+        if overlap is None and not overlap_env_pinned():
+            from ..tuning import plan as _tuneplan
+            tplan = _tuneplan.get_plan("halo", shape=self.global_dims,
+                                       dtype=dtype, mesh=self.mesh)
+            if tplan is not None \
+                    and tplan.get("overlap") in ("on", "off"):
+                overlap = tplan.get("overlap")
+        self._overlap = overlap_enabled(overlap)
         if len(self.mesh.axis_names) != 1:
             raise ValueError(
                 "MPIHalo requires a single-axis (1-D) mesh: its shard_map "

@@ -57,7 +57,6 @@ class MPIVStack(MPILinearOperator):
         self.ops = list(ops)
         self.mask = tuple(mask) if mask is not None else None
         self.compute_dtype = compute_dtype
-        self._overlap = overlap_enabled(overlap)
         from ..parallel.mesh import default_mesh
         self.mesh = mesh if mesh is not None else default_mesh()
         cols = {op.shape[1] for op in self.ops}
@@ -70,6 +69,18 @@ class MPIVStack(MPILinearOperator):
             (int(sum(op.shape[0] for op in c)),) for c in self.chunks)
         shape = (int(self.nops.sum()), int(cols.pop()))
         dtype = dtype or np.result_type(*[op.dtype for op in self.ops])
+        # autotuner seam (round 10): overlap left at None consults the
+        # plan (inert when PYLOPS_MPI_TPU_TUNE=off); an explicit
+        # overlap= kwarg or explicit env pin always wins
+        from ..utils.deps import overlap_env_pinned
+        if overlap is None and not overlap_env_pinned():
+            from ..tuning import plan as _tuneplan
+            tplan = _tuneplan.get_plan("stack", shape=shape,
+                                       dtype=dtype, mesh=self.mesh)
+            if tplan is not None \
+                    and tplan.get("overlap") in ("on", "off"):
+                overlap = tplan.get("overlap")
+        self._overlap = overlap_enabled(overlap)
         super().__init__(shape=shape, dtype=dtype)
         if self.compute_dtype is None:  # env-policy default (f32 only)
             from ._precision import default_compute_dtype

@@ -1,0 +1,393 @@
+"""Per-operator tuning spaces and their cost-model seeds.
+
+Every discrete plan choice the operator stack exposes — SUMMA gather
+vs stationary-A, ``overlap=on|off``, ``comm_chunks=K``, Pallas-vs-XLA
+normal path — is declared here as a :class:`TuningSpace`: a named set
+of axes with candidate values plus a cost function that SEEDS the
+search order from the analytic model (``diagnostics/costmodel.py``).
+The searcher (``search.py``) then refines the seed by measurement;
+both arXiv 2112.09017 and arXiv 2112.01075 show the best
+collective/schedule is topology- and shape-dependent, so the seed is
+a ranking hint, never the verdict.
+
+Design rules:
+
+- **The cost-model pick must equal today's defaults** on every
+  platform: the seed exists so ``PYLOPS_MPI_TPU_TUNE=on`` without a
+  measured cache behaves exactly like the hand-set ``auto`` seams
+  (overlap off on CPU sim / on on TPU, schedule by comm volume,
+  fused normal path when available). Measurement is the only thing
+  that can move a plan off the defaults.
+- **Fixed axes** are recorded, not searched — e.g. the FFT engine
+  (planar vs complex) is resolved by the global
+  ``PYLOPS_MPI_TPU_FFT_MODE`` seam and pinned by complex-free HLO
+  tests; the space declares it so the plan carries the full schedule
+  provenance, but the tuner never flips it.
+- New operators REGISTER a space here instead of growing new env
+  knobs — the tuner, the offline CLI, the plan cache and the docs
+  table all pick it up from this one declaration.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Axis", "TuningSpace", "space_for", "register_space",
+           "candidates", "rank", "default_params", "SPACES"]
+
+
+# per-collective dispatch overhead used by the seeds: the CPU sim pays
+# real python/XLA dispatch per extra collective with nothing to hide
+# behind; on TPU the latency-hiding scheduler overlaps the hops
+_DISPATCH_S = {"cpu": 50e-6, "tpu": 5e-6}
+
+
+@dataclass(frozen=True)
+class Axis:
+    """One tunable dimension: ``candidates`` in preference order
+    (index 0 = today's default — ties in the cost seed keep this
+    order, so an uninformative model degrades to current behavior).
+    ``fixed`` axes are recorded in the plan but never searched."""
+
+    name: str
+    candidates: Tuple
+    fixed: bool = False
+
+
+@dataclass
+class TuningSpace:
+    """Declared plan space for one operator family.
+
+    ``cost(context, params) -> Optional[float]`` predicts seconds for
+    one apply under ``params`` (lower is better; ``None`` = no model,
+    candidate keeps declaration order). ``enumerate_fn(context)``
+    overrides the default cartesian product when candidates are
+    conditional (e.g. ``comm_chunks`` only varies with overlap on).
+    """
+
+    op: str
+    axes: Tuple[Axis, ...]
+    cost: Optional[Callable[[Dict, Dict], Optional[float]]] = None
+    enumerate_fn: Optional[Callable[[Dict], List[Dict]]] = None
+    default_fn: Optional[Callable[[Dict], Dict]] = None
+    note: str = ""
+
+    def axis(self, name: str) -> Optional[Axis]:
+        for ax in self.axes:
+            if ax.name == name:
+                return ax
+        return None
+
+    def validate(self, params: Dict) -> bool:
+        """True when every (name, value) pair fits a declared axis —
+        the gate a cached plan must pass before it is applied (a
+        schema-valid cache can still carry a stale axis value after a
+        code change; such entries are treated as misses)."""
+        for k, v in params.items():
+            ax = self.axis(k)
+            if ax is None or v not in ax.candidates:
+                return False
+        return True
+
+
+# ------------------------------------------------------------- cost seeds
+def _peaks(context: Dict) -> Dict:
+    """Roofline peaks for the seed: spec-sheet per-chip numbers on
+    TPU; the bench's assumed stream bandwidth carved across virtual
+    devices on the CPU sim (the point is ORDERING candidates, not
+    absolute prediction — same convention as bench.py's roofline
+    rows)."""
+    nd = max(1, int(context.get("n_dev") or 1))
+    if context.get("platform") == "tpu":
+        from ..diagnostics import costmodel
+        chip = context.get("chip") or ""
+        return {"flops": costmodel.peak_flops(chip, "f32_highest"),
+                "hbm_gbps": costmodel.peak_hbm_gbps(chip),
+                "ici_gbps": costmodel.peak_ici_gbps(chip)}
+    return {"flops": None, "hbm_gbps": 30.0 / nd, "ici_gbps": 30.0 / nd}
+
+
+def _dispatch_s(context: Dict) -> float:
+    return _DISPATCH_S["tpu" if context.get("platform") == "tpu"
+                       else "cpu"]
+
+
+def _itemsize(context: Dict) -> int:
+    try:
+        return int(np.dtype(context.get("dtype") or "float32").itemsize)
+    except TypeError:
+        return 4
+
+
+def _overlap_seed(context: Dict, params: Dict, ici_bytes: float,
+                  steps: int, base_s: float = 0.0) -> float:
+    """Shared seed for the binary bulk-vs-pipelined choice: on TPU the
+    ring/chunked schedule hides ~half the ICI time behind compute; on
+    the CPU sim there is nothing to hide and each extra hop costs a
+    dispatch — reproducing exactly the ``overlap=auto`` policy
+    (``utils/deps.py``) the seed must not diverge from."""
+    pk = _peaks(context)
+    t_ici = (ici_bytes / (pk["ici_gbps"] * 1e9)
+             if pk.get("ici_gbps") and ici_bytes else 0.0)
+    on = params.get("overlap") == "on"
+    if not on:
+        return base_s + t_ici
+    hide = 0.5 if context.get("platform") == "tpu" else 0.0
+    return base_s + (1.0 - hide) * t_ici \
+        + max(0, steps) * _dispatch_s(context)
+
+
+def _cost_matrixmult(context: Dict, params: Dict) -> Optional[float]:
+    shape = context.get("shape")
+    if not shape or len(shape) != 3:
+        return None
+    N, K, M = (int(s) for s in shape)
+    grid = tuple(context.get("extra", {}).get("grid") or (1, 1))
+    pr, pc = max(1, int(grid[0])), max(1, int(grid[1]))
+    P = pr * pc
+    it = _itemsize(context)
+    from ..diagnostics.costmodel import summa_comm_volume
+    vols = summa_comm_volume(N, K, M, (pr, pc))
+    vol = vols.get(params.get("schedule", "gather"), vols["gather"])
+    pk = _peaks(context)
+    flops = 2.0 * N * K * M / P
+    hbm = (N * K + K * M + N * M) * it / P
+    t_comp = flops / pk["flops"] if pk.get("flops") else 0.0
+    t_hbm = hbm / (pk["hbm_gbps"] * 1e9) if pk.get("hbm_gbps") else 0.0
+    return _overlap_seed(context, params, vol * it, steps=pc - 1,
+                         base_s=max(t_comp, t_hbm))
+
+
+def _cost_fft(context: Dict, params: Dict) -> Optional[float]:
+    shape = context.get("shape")
+    if not shape:
+        return None
+    P = max(1, int(context.get("n_dev") or 1))
+    it = _itemsize(context)
+    n_total = float(np.prod([int(s) for s in shape]))
+    from ..diagnostics.costmodel import pencil_transpose_cost
+    c = pencil_transpose_cost(tuple(int(s) for s in shape), P,
+                              itemsize=it)
+    pk = _peaks(context)
+    flops = 5.0 * n_total * math.log2(max(2.0, n_total)) / P
+    t_comp = flops / pk["flops"] if pk.get("flops") else 0.0
+    t_hbm = (c.hbm_bytes / (pk["hbm_gbps"] * 1e9)
+             if pk.get("hbm_gbps") else 0.0)
+    K = int(params.get("comm_chunks", 1))
+    # each chunk adds one all-to-all dispatch pair per transpose; more
+    # chunks hide more of the transfer behind the per-chunk transforms
+    base = max(t_comp, t_hbm)
+    if params.get("overlap") != "on" or K <= 1:
+        pk_ici = pk.get("ici_gbps")
+        return base + (c.ici_bytes / (pk_ici * 1e9) if pk_ici else 0.0)
+    hide = (0.5 * (1.0 - 1.0 / K)
+            if context.get("platform") == "tpu" else 0.0)
+    pk_ici = pk.get("ici_gbps")
+    t_ici = c.ici_bytes / (pk_ici * 1e9) if pk_ici else 0.0
+    return base + (1.0 - hide) * t_ici \
+        + 2 * (K - 1) * _dispatch_s(context)
+
+
+def _cost_blockdiag(context: Dict, params: Dict) -> Optional[float]:
+    extra = context.get("extra", {})
+    a_bytes = float(extra.get("a_bytes") or 0.0)
+    if not a_bytes:
+        return None
+    P = max(1, int(context.get("n_dev") or 1))
+    pk = _peaks(context)
+    # the normal-equation apply is HBM-bound: the fused (Pallas/FFI)
+    # path streams the block stack ONCE per (u, q) pair, the two-sweep
+    # einsum pair twice — the whole reason the kernel exists
+    sweeps = 1.0 if params.get("normal_path") == "fused" else 2.0
+    if not pk.get("hbm_gbps"):
+        return sweeps
+    return sweeps * a_bytes / P / (pk["hbm_gbps"] * 1e9)
+
+
+def _cost_stack(context: Dict, params: Dict) -> Optional[float]:
+    shape = context.get("shape")
+    if not shape:
+        return None
+    P = max(1, int(context.get("n_dev") or 1))
+    it = _itemsize(context)
+    out_len = int(shape[-1])
+    ici = out_len * it * 2.0 * (P - 1) / max(1, P)  # adjoint psum
+    return _overlap_seed(context, params, ici, steps=P - 1)
+
+
+def _cost_halo_family(context: Dict, params: Dict) -> Optional[float]:
+    shape = context.get("shape")
+    if not shape:
+        return None
+    P = max(1, int(context.get("n_dev") or 1))
+    it = _itemsize(context)
+    row = float(np.prod([int(s) for s in shape])) / max(1, int(shape[0]))
+    ici = 2.0 * row * it if P > 1 else 0.0  # two ghost slabs
+    return _overlap_seed(context, params, ici, steps=2)
+
+
+def _enum_fft(context: Dict) -> List[Dict]:
+    """Overlap off makes the chunk count moot — one canonical bulk
+    candidate plus the chunked ladder, instead of a product full of
+    aliases that would waste measurement trials."""
+    from ..utils.deps import comm_chunks_default
+    ladder = []
+    seen = set()
+    for k in (comm_chunks_default(), 2, 4, 8):
+        if k > 1 and k not in seen:
+            seen.add(k)
+            ladder.append({"overlap": "on", "comm_chunks": int(k)})
+    return [{"overlap": "off", "comm_chunks": 1}] + ladder
+
+
+def _enum_blockdiag(context: Dict) -> List[Dict]:
+    if context.get("extra", {}).get("fused_available"):
+        return [{"normal_path": "fused"}, {"normal_path": "two_sweep"}]
+    return [{"normal_path": "two_sweep"}]
+
+
+# --------------------------------------------------------------- registry
+SPACES: Dict[str, TuningSpace] = {}
+
+
+def register_space(space: TuningSpace) -> None:
+    """Register (or replace) the tuning space for one operator family
+    — the extension point new kernels use instead of a new env knob."""
+    SPACES[space.op] = space
+
+
+def space_for(op: str) -> Optional[TuningSpace]:
+    return SPACES.get(op)
+
+
+def candidates(space: TuningSpace, context: Optional[Dict] = None) \
+        -> List[Dict]:
+    """Searchable candidate param dicts (fixed axes excluded), in
+    declaration order — index 0 is today's default configuration."""
+    context = context or {}
+    if space.enumerate_fn is not None:
+        return [dict(p) for p in space.enumerate_fn(context)]
+    out: List[Dict] = [{}]
+    for ax in space.axes:
+        if ax.fixed:
+            continue
+        out = [dict(p, **{ax.name: c}) for p in out
+               for c in ax.candidates]
+    return out
+
+
+def default_params(space: TuningSpace, context: Optional[Dict] = None) \
+        -> Dict:
+    """The candidate matching current (pre-tuner) behavior — the race
+    baseline the acceptance bar compares against. ``default_fn`` wins
+    when declared (matrixmult: ``schedule="auto"`` IS the comm-volume
+    pick, not a fixed value); otherwise first in declaration order,
+    with platform-dependent defaults resolved the way the env seams
+    resolve them (``overlap=auto``: off on CPU sim, on on TPU)."""
+    context = context or {}
+    if space.default_fn is not None:
+        return dict(space.default_fn(context))
+    cands = candidates(space, context)
+    dflt = dict(cands[0])
+    if "overlap" in dflt and context.get("platform") == "tpu":
+        # overlap=auto is ON on real TPU (utils/deps.py); pick the
+        # first candidate carrying it
+        for c in cands:
+            if c.get("overlap") == "on":
+                return dict(c)
+    return dflt
+
+
+def rank(space: TuningSpace, context: Dict) -> List[Dict]:
+    """Candidates ordered by the cost seed (stable sort: ties keep
+    declaration order, i.e. the default first)."""
+    cands = candidates(space, context)
+    if space.cost is None:
+        return cands
+    scored = []
+    for i, p in enumerate(cands):
+        try:
+            c = space.cost(context, p)
+        except Exception:
+            c = None
+        scored.append((c if c is not None else float("inf"), i, p))
+    scored.sort(key=lambda t: (t[0], t[1]))
+    return [p for _, _, p in scored]
+
+
+def _default_matrixmult(context: Dict) -> Dict:
+    """Today's ``schedule="auto"`` resolution: the comm-volume pick
+    (ops/matrixmult.py) — what an untuned construction would run."""
+    shape = context.get("shape") or (1, 1, 1)
+    grid = tuple(context.get("extra", {}).get("grid") or (1, 1))
+    from ..diagnostics.costmodel import summa_comm_volume
+    vols = summa_comm_volume(int(shape[0]), int(shape[1]),
+                             int(shape[2]), grid)
+    return {"schedule": ("stat_a" if vols["stat_a"] < vols["gather"]
+                         else "gather"),
+            "overlap": ("on" if context.get("platform") == "tpu"
+                        else "off")}
+
+
+register_space(TuningSpace(
+    op="matrixmult",
+    axes=(Axis("schedule", ("gather", "stat_a")),
+          Axis("overlap", ("off", "on")),
+          Axis("comm_chunks", (1,), fixed=True)),
+    cost=_cost_matrixmult,
+    default_fn=_default_matrixmult,
+    note="SUMMA forward schedule x ring overlap; chunking is carried "
+         "by the ring step count, recorded for provenance only"))
+
+register_space(TuningSpace(
+    op="fft",
+    axes=(Axis("overlap", ("off", "on")),
+          Axis("comm_chunks", (1, 2, 4, 8)),
+          Axis("engine", ("resolved",), fixed=True)),
+    cost=_cost_fft,
+    enumerate_fn=_enum_fft,
+    note="pencil-transpose chunking; the planar/complex engine is the "
+         "global PYLOPS_MPI_TPU_FFT_MODE seam (complex-free HLO pins) "
+         "— recorded in the plan, never flipped by the tuner"))
+
+register_space(TuningSpace(
+    op="blockdiag",
+    axes=(Axis("normal_path", ("fused", "two_sweep")),
+          Axis("tile", ("kernel_default",), fixed=True)),
+    cost=_cost_blockdiag,
+    enumerate_fn=_enum_blockdiag,
+    note="fused (Pallas/XLA-FFI one-sweep) vs two-sweep normal "
+         "equations; Pallas tile shape is fixed by the Mosaic 8x128 "
+         "rule (ops/pallas_kernels.py), recorded for provenance"))
+
+register_space(TuningSpace(
+    op="stack",
+    axes=(Axis("overlap", ("off", "on")),),
+    cost=_cost_stack,
+    note="batched adjoint reduction: partitioner psum vs explicit "
+         "ring reduce-scatter"))
+
+register_space(TuningSpace(
+    op="derivative",
+    axes=(Axis("overlap", ("off", "on")),),
+    cost=_cost_halo_family,
+    note="ghost strategy: bulk halo-extend vs interior/boundary split "
+         "with in-flight ghost ppermutes"))
+
+register_space(TuningSpace(
+    op="halo",
+    axes=(Axis("overlap", ("off", "on")),),
+    cost=_cost_halo_family,
+    note="repack from the pre-exchange block (select-merged) vs the "
+         "post-exchange extended block"))
+
+register_space(TuningSpace(
+    op="pencil_transpose",
+    axes=(Axis("comm_chunks", (1, 2, 4, 8)),),
+    cost=None,
+    note="standalone chunk-count plans consumed by "
+         "collectives.resolve_chunks for default-chunked transposes"))

@@ -29,7 +29,7 @@ from typing import Callable, List, Optional
 
 import jax
 
-__all__ = ["benchmark", "mark", "profile_trace"]
+__all__ = ["benchmark", "mark", "profile_trace", "time_callable"]
 
 
 def _enabled() -> bool:
@@ -150,6 +150,28 @@ def benchmark(func: Optional[Callable] = None, description: str = "",
     if func is not None:
         return actual_decorator(func)
     return actual_decorator
+
+
+def time_callable(fn: Callable, repeats: int = 3, warmup: int = 1):
+    """Time a zero-arg callable with the module's sync discipline
+    (``_sync`` on the returned value — the same barrier the
+    ``@benchmark`` decorator applies): ``warmup`` unrecorded calls
+    (compile/first-dispatch), then ``repeats`` timed calls. Returns
+    ``{"best_s", "mean_s", "times_s"}`` — the timing primitive behind
+    the autotuner's measurement trials
+    (:mod:`pylops_mpi_tpu.tuning.search`)."""
+    for _ in range(max(0, int(warmup))):
+        _sync((fn(),))
+    times = []
+    for _ in range(max(1, int(repeats))):
+        _sync()
+        t0 = time.perf_counter()
+        out = fn()
+        _sync((out,))
+        times.append(time.perf_counter() - t0)
+    return {"best_s": min(times),
+            "mean_s": sum(times) / len(times),
+            "times_s": times}
 
 
 @contextmanager

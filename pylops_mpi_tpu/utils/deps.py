@@ -53,9 +53,117 @@ import os
 
 __all__ = ["jax_enabled", "platform_override", "x64_enabled",
            "explicit_stencil_enabled", "apply_environment",
-           "overlap_mode", "overlap_enabled", "comm_chunks_default"]
+           "overlap_mode", "overlap_enabled", "comm_chunks_default",
+           "overlap_env_pinned", "comm_chunks_env_pinned",
+           "KNOBS", "knob_names", "knob_table_markdown"]
 
 jax_enabled = True  # the only engine; mirrors deps.nccl_enabled's role
+
+
+# --------------------------------------------------------- knob registry
+# The ONE table of every PYLOPS_MPI_TPU_* environment knob (round 10):
+# (name, values, default, consumer module(s), one-line purpose).
+# tests/test_tuning.py greps the package for knob reads and fails on
+# any knob missing here; docs/tpu.md renders this table
+# (knob_table_markdown) instead of per-PR ad-hoc lists. Add a row when
+# you add a knob — or better, register a tuning space
+# (pylops_mpi_tpu/tuning/space.py) instead of adding one.
+KNOBS = [
+    ("PYLOPS_MPI_TPU_PLATFORM", "cpu|tpu|…", "unset (auto)",
+     "utils/deps.py",
+     "force the JAX platform before first backend use"),
+    ("PYLOPS_MPI_TPU_X64", "0|1", "0", "utils/deps.py",
+     "enable float64 (TPUs prefer f32/bf16)"),
+    ("PYLOPS_MPI_TPU_MATMUL_PRECISION", "highest|default|…", "highest",
+     "utils/deps.py",
+     "jax_default_matmul_precision pin (f32 means f32 on the MXU)"),
+    ("PYLOPS_MPI_TPU_EXPLICIT_STENCIL", "0|1", "1",
+     "utils/deps.py, ops/derivatives.py",
+     "hand-scheduled shard_map stencil path vs implicit GSPMD"),
+    ("PYLOPS_MPI_TPU_OVERLAP", "auto|on|off", "auto",
+     "utils/deps.py (ops/matrixmult|fft|stack|derivatives|halo)",
+     "pipelined-collectives seam: ring SUMMA, chunked transposes, "
+     "split halo stencils"),
+    ("PYLOPS_MPI_TPU_COMM_CHUNKS", "int>=1", "4",
+     "utils/deps.py, ops/fft.py",
+     "default chunk count for streamed pencil transposes"),
+    ("PYLOPS_MPI_TPU_PRECISION", "f32|bf16|c64", "f32",
+     "ops/_precision.py",
+     "storage/compute precision policy for operators built with "
+     "compute_dtype=None"),
+    ("PYLOPS_MPI_TPU_DONATE", "0|1", "1",
+     "ops/_precision.py, solvers/basic.py, utils/hlo.py",
+     "buffer donation of the fused solvers' model-vector argument"),
+    ("PYLOPS_MPI_TPU_FUSED_CACHE", "int>=1", "32", "solvers/basic.py",
+     "fused-solver executable cache capacity"),
+    ("PYLOPS_MPI_TPU_FFT_MODE", "auto|xla|matmul|planar", "auto",
+     "ops/dft.py",
+     "local-FFT engine seam (planar = complex-free plane pairs)"),
+    ("PYLOPS_MPI_TPU_FFTLESS_RUNTIMES", "csv of runtime substrings",
+     "built-in list", "ops/dft.py",
+     "runtimes known to lack the fft custom-call (auto avoids XLA "
+     "FFT there)"),
+    ("PYLOPS_MPI_TPU_DFT_BASE", "int", "128 on TPU / 16 on CPU",
+     "ops/dft.py", "mixed-radix GEMM base of the matmul DFT engine"),
+    ("PYLOPS_MPI_TPU_FFI_COMPLEX", "0|1", "1", "ops/blockdiag.py",
+     "complex blocks may use the native XLA-FFI fused-normal kernel"),
+    ("PYLOPS_MPI_TPU_FFI_THREADS", "int", "cores/devices",
+     "native/ffi.py", "threads per FFI fused-normal kernel call"),
+    ("PYLOPS_MPI_TPU_NATIVE", "0|1", "1", "native/__init__.py",
+     "build/load the native host-pack helper library"),
+    ("PYLOPS_MPI_TPU_NATIVE_THREADS", "int", "min(16, cores)",
+     "native/__init__.py", "threads for native pack/IO helpers"),
+    ("PYLOPS_MPI_TPU_CKPT_BACKEND", "native|orbax", "native",
+     "utils/checkpoint.py", "checkpoint encode/decode backend"),
+    ("PYLOPS_MPI_TPU_TRACE", "off|spans|full", "off",
+     "diagnostics/trace.py (linearoperator, collectives, solvers)",
+     "structured span tracing; full adds in-loop solver telemetry"),
+    ("PYLOPS_MPI_TPU_TRACE_FILE", "path", "unset",
+     "diagnostics/trace.py", "auto-dump the trace JSONL at exit"),
+    ("PYLOPS_MPI_TPU_TRACE_BUFFER", "int", "65536",
+     "diagnostics/trace.py", "trace ring-buffer capacity (events)"),
+    ("PYLOPS_MPI_TPU_TELEMETRY", "auto|on|off", "auto",
+     "diagnostics/telemetry.py",
+     "in-loop solver telemetry gate under TRACE=full"),
+    ("PYLOPS_MPI_TPU_PROFILE_DIR", "path", "unset",
+     "diagnostics/profiler.py",
+     "jax.profiler capture dir for profile_capture regions"),
+    ("PYLOPS_MPI_TPU_TUNE", "off|on|auto", "off",
+     "tuning/plan.py (ops/*, parallel/collectives.py)",
+     "autotuner seam: on replays cached/cost-model plans, auto also "
+     "measures on cache miss"),
+    ("PYLOPS_MPI_TPU_TUNE_CACHE", "path", "unset (memory-only)",
+     "tuning/cache.py", "persistent JSON plan cache"),
+    ("PYLOPS_MPI_TPU_TUNE_BUDGET", "seconds", "STAGE_BUDGETS['tune']",
+     "tuning/search.py", "wall budget for one measurement search"),
+    ("PYLOPS_MPI_TPU_TUNE_TOPK", "int>=1", "4", "tuning/search.py",
+     "how many seed-ranked candidates get timed"),
+    ("PYLOPS_MPI_TPU_TUNE_MARGIN", "float", "0.02", "tuning/search.py",
+     "fractional win required to move off the default plan"),
+    ("PYLOPS_MPI_TPU_TEST_DEVICES", "int", "8",
+     "tests/conftest.py, .github/workflows/build.yml",
+     "virtual-device count of the CPU-sim test mesh"),
+]
+
+
+def knob_names():
+    """Registered knob names (the set the registry test checks package
+    reads against)."""
+    return [row[0] for row in KNOBS]
+
+
+def knob_table_markdown() -> str:
+    """Render the registry as the markdown table embedded in
+    docs/tpu.md ("Environment knobs") — regenerate the docs section
+    with ``python -c "from pylops_mpi_tpu.utils.deps import
+    knob_table_markdown; print(knob_table_markdown())"`` after adding
+    a row."""
+    lines = ["| knob | values | default | consumer | purpose |",
+             "| --- | --- | --- | --- | --- |"]
+    for name, values, default, consumer, purpose in KNOBS:
+        lines.append(f"| `{name}` | `{values}` | {default} | "
+                     f"{consumer} | {purpose} |")
+    return "\n".join(lines)
 
 
 def platform_override():
@@ -120,6 +228,21 @@ def overlap_enabled(user=None) -> bool:
         return False
     import jax
     return jax.default_backend() == "tpu"
+
+
+def overlap_env_pinned() -> bool:
+    """True when ``PYLOPS_MPI_TPU_OVERLAP`` is explicitly ``on`` or
+    ``off`` — explicit env settings are user intent and beat the
+    autotuner's plans, exactly like an explicit ``overlap=`` kwarg
+    (``auto``/unset leaves the plan seam free to decide)."""
+    return overlap_mode() in ("on", "off")
+
+
+def comm_chunks_env_pinned() -> bool:
+    """True when ``PYLOPS_MPI_TPU_COMM_CHUNKS`` is explicitly set
+    (even to the default value) — same tuner-precedence rule as
+    :func:`overlap_env_pinned`."""
+    return "PYLOPS_MPI_TPU_COMM_CHUNKS" in os.environ
 
 
 def comm_chunks_default() -> int:
