@@ -549,6 +549,14 @@ def child_main():
         gbps = (sweeps * nblock * nblock * nblk * itemsize / per_iter) / 1e9
         rel_err = float(np.linalg.norm(out[0].asarray() - xtrue)
                         / np.linalg.norm(xtrue))
+        # solver-status stamp (ISSUE 6): the headline runs guards-off
+        # (bench times the production fast path), so the status is the
+        # host-side resolution — a non-finite solution is a breakdown
+        # the resilience layer would have caught in-loop
+        iit = int(out[1])
+        measure.last_status = (
+            "breakdown" if not np.isfinite(rel_err)
+            else ("converged" if iit < niter else "maxiter"))
         return 1.0 / per_iter, gflops, gbps, rel_err, use_normal
 
     # Component configs: on CPU they run in-process before the headline
@@ -604,6 +612,7 @@ def child_main():
     f32_ips, f32_gflops, f32_gbps, f32_err, _ = measure(bf16=False,
                                                         fused_normal=False)
     f32_spread = getattr(measure, "last_spread_pct", None)
+    f32_status = getattr(measure, "last_status", None)
     f32_mode = "f32 two-sweep"
     f32_race = None
     # On CPU, race the native one-pass normal kernel (XLA-FFI,
@@ -625,6 +634,7 @@ def child_main():
                 f32_ips, f32_gflops, f32_gbps, f32_err = (n_ips, n_gflops,
                                                           n_gbps, n_err)
                 f32_spread = getattr(measure, "last_spread_pct", None)
+                f32_status = getattr(measure, "last_status", None)
                 f32_mode = "f32 fused-normal (native one-pass)"
     bf16_race = None
     bf16_res = None
@@ -632,6 +642,7 @@ def child_main():
         _progress("headline bf16 fused-normal")
         b_ips, b_gflops, b_gbps, b_err, used_nrm = measure(
             bf16=True, fused_normal=True)
+        b_status = getattr(measure, "last_status", None)
         b_mode = ("bf16-storage fused-normal" if used_nrm
                   else "bf16-storage two-sweep")
         if used_nrm:
@@ -646,6 +657,7 @@ def child_main():
                          "two_sweep_iters_per_sec": round(ips2, 2)}
             if ips2 > b_ips:
                 b_ips, b_gflops, b_gbps, b_err = ips2, gflops2, gbps2, err2
+                b_status = getattr(measure, "last_status", None)
                 b_mode = "bf16-storage two-sweep (won race)"
     elif measure_bf16:
         # CPU-sim leg: two-sweep only (the Pallas interpret-mode
@@ -655,6 +667,7 @@ def child_main():
         _progress("headline bf16 two-sweep (cpu-sim, race vs f32)")
         b_ips, b_gflops, b_gbps, b_err, _ = measure(
             bf16=True, fused_normal=False, reps_override=3)
+        b_status = getattr(measure, "last_status", None)
         b_mode = "bf16-storage two-sweep (cpu-sim)"
         bf16_race = {"two_sweep_iters_per_sec": round(b_ips, 2),
                      "f32_two_sweep_iters_per_sec": round(f32_ips, 2)}
@@ -663,6 +676,10 @@ def child_main():
                     "gflops": round(b_gflops, 1),
                     "hbm_gbps": round(b_gbps, 1),
                     "rel_err": f"{b_err:.1e}", "mode": b_mode,
+                    # resilience stamps (ISSUE 6): solve exit status +
+                    # restart count (0 — bench times the single-attempt
+                    # fast path, resilient_solve is not in the loop)
+                    "status": b_status, "restarts": 0,
                     # the cliff detector: round 5 banked 0.025 here
                     "vs_f32": round(b_ips / f32_ips, 2)
                     if f32_ips else None}
@@ -861,6 +878,11 @@ def child_main():
         "unit": "iters/s",
         "vs_baseline": round(ips / cpu_ips, 2),
         "plan": plan_prov,  # tuned | costmodel | default (round 10)
+        # resilience stamps (ISSUE 6): headline solve exit status +
+        # restart count (0 = single attempt, no resilient driver)
+        "status": (b_status if (primary_bf16 and bf16_res is not None)
+                   else f32_status),
+        "restarts": 0,
         "mfu": mfu,
         "hbm_gbps": round(gbps, 1),  # the roofline that matters: GEMV
                                      # solves are HBM-bandwidth-bound
@@ -872,6 +894,7 @@ def child_main():
         **({"roofline": head_roofline} if head_roofline else {}),
         "f32": {"iters_per_sec": round(f32_ips, 2),
                 "plan": plan_prov,
+                "status": f32_status, "restarts": 0,
                 "gflops": round(f32_gflops, 1),
                 "hbm_gbps": round(f32_gbps, 1),
                 **_hbm_fields(f32_gbps, 4),
@@ -1322,6 +1345,9 @@ def _compact_line(result):
         "nblock": result.get("nblock"),
         "numpy_baseline_iters_per_sec":
             result.get("numpy_baseline_iters_per_sec"),
+        # resilience stamps (ISSUE 6) ride every compact line
+        "status": result.get("status"),
+        "restarts": result.get("restarts"),
         "detail_file": "bench_detail.json",
     }
     for k in ("degraded", "cached", "cache_stage", "partial",
@@ -1332,12 +1358,12 @@ def _compact_line(result):
     if "f32" in result:
         compact["f32"] = {k: result["f32"].get(k) for k in
                           ("iters_per_sec", "vs_baseline", "hbm_gbps",
-                           "hbm_pct", "on_chip_resident")
+                           "hbm_pct", "on_chip_resident", "status")
                           if result["f32"].get(k) is not None}
     if result.get("bf16"):
         compact["bf16"] = {k: result["bf16"].get(k) for k in
                            ("iters_per_sec", "rel_err", "mode", "vs_f32",
-                            "hbm_pct", "on_chip_resident")
+                            "hbm_pct", "on_chip_resident", "status")
                            if result["bf16"].get(k) is not None}
     if result.get("bf16_race"):
         compact["bf16_race"] = result["bf16_race"]

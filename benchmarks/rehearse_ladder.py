@@ -268,6 +268,68 @@ def main() -> None:
         }
     art["deadline_runner"] = dr
 
+    # ---- pass 3d: segmented checkpoint/resume under the deadline
+    # (ISSUE 6) — a segmented fused CGLS killed MID-STAGE at its
+    # budget must have banked a fused-carry checkpoint, and the
+    # resumed stage must complete inside the remaining window and
+    # land on the exact trajectory an uninterrupted run produces ----
+    seg = {"ok": False, "note": "profiler module unavailable"}
+    if prof is not None:
+        ckpt = os.path.join(probe_dir, "seg_carry.ckpt")
+        env6 = dict(env2)
+        env6.update({"SEG_CKPT": ckpt, "SEG_NITER": "40",
+                     "SEG_EPOCH": "5"})
+        kill_s = int(os.environ.get("REHEARSE_SEG_KILL_S", "90"))
+        env6k = dict(env6)
+        # every epoch sleeps past the budget: the kill ALWAYS lands
+        # after the first checkpoint and before completion
+        env6k["SEG_EPOCH_SLEEP"] = str(kill_s)
+        seg_runner = prof.DeadlineRunner(deadline_ts=time.time() + 3600)
+
+        def _seg_stage(e):
+            def stage(t):
+                return bench._run_json_cmd(
+                    [sys.executable,
+                     os.path.join(_HERE, "segmented_stage.py")],
+                    e, cwd=_ROOT, timeout=t)
+            return stage
+
+        rec_kill = seg_runner.run("segmented_kill", _seg_stage(env6k),
+                                  kill_s)
+        ckpt_banked = os.path.exists(ckpt)
+        rec_res = seg_runner.run("segmented_resume", _seg_stage(env6),
+                                 BUDGETS["flagship_small"])
+        env_ref = dict(env6)
+        env_ref.pop("SEG_CKPT")
+        rec_ref = seg_runner.run("segmented_reference",
+                                 _seg_stage(env_ref),
+                                 BUDGETS["flagship_small"])
+        r_res = rec_res.get("result") or {}
+        r_ref = rec_ref.get("result") or {}
+        seg = {
+            "killed_at_budget": bool(rec_kill.get("hit_budget")),
+            "checkpoint_banked": ckpt_banked,
+            "resume_seconds": rec_res.get("seconds"),
+            "resume_iiter": r_res.get("iiter"),
+            "resume_epochs": r_res.get("epochs"),
+            "resumed_flag": r_res.get("resumed"),
+            "reference_epochs": r_ref.get("epochs"),
+            # the identity proof: the resumed trajectory lands on the
+            # exact same final iterate as an uninterrupted run, after
+            # doing strictly fewer epochs in its own process
+            "trajectory_identical": bool(
+                r_res.get("x_hash") and
+                r_res.get("x_hash") == r_ref.get("x_hash")),
+            "ok": bool(rec_kill.get("hit_budget") and ckpt_banked
+                       and r_res.get("resumed")
+                       and r_res.get("iiter") == 40
+                       and r_res.get("x_hash")
+                       and r_res.get("x_hash") == r_ref.get("x_hash")
+                       and (r_res.get("epochs") or 99)
+                       < (r_ref.get("epochs") or 0)),
+        }
+    art["segmented_resume"] = seg
+
     # ---- pass 4: rehearsal caches must NEVER read as TPU evidence ----
     merged = bench._merge_tpu_cache(
         {"platform": "cpu", "value": 1.0, "degraded": True},
@@ -279,6 +341,7 @@ def main() -> None:
     art["ok"] = bool(art["ladder_ok"] and art["salvage"]["ok"]
                      and art["breakdown_salvage"]["ok"]
                      and art["deadline_runner"]["ok"]
+                     and art["segmented_resume"]["ok"]
                      and art["deadline_records_ok"]
                      and art["no_false_promotion"]["ok"])
     out_path = os.path.join(_HERE, "rehearsal_r04.json")
@@ -292,6 +355,8 @@ def main() -> None:
                           art["breakdown_salvage"]["ok"],
                       "deadline_runner_ok":
                           art["deadline_runner"]["ok"],
+                      "segmented_resume_ok":
+                          art["segmented_resume"]["ok"],
                       "deadline_records_ok": art["deadline_records_ok"],
                       "no_false_promotion":
                           art["no_false_promotion"]["ok"],

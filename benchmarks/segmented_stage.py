@@ -1,0 +1,77 @@
+"""Segmented-CGLS stage child for the rehearse ladder (pass 3d).
+
+Runs one segmented fused CGLS solve (solvers/segmented.py) on the CPU
+8-virtual-device mesh, checkpointing every epoch to ``SEG_CKPT`` and
+auto-resuming from it — the subprocess the rehearsal kills mid-stage
+to prove kill → checkpoint banked → resume completes inside the
+remaining DeadlineRunner window. Prints one JSON line:
+``{"iiter", "status", "epochs", "resumed", "x_hash"}`` (``x_hash`` is
+a sha256 of the final iterate's bytes, the cross-process
+trajectory-identity handle; ``epochs`` counts only THIS process's
+epochs, so a resumed run reports fewer than a cold one).
+
+Env knobs: ``SEG_CKPT`` (checkpoint path; unset = no checkpointing),
+``SEG_NITER`` (default 40), ``SEG_EPOCH`` (default 5), ``SEG_NBLOCK``
+(block size, default 48), ``SEG_EPOCH_SLEEP`` (seconds slept after
+every epoch — the deterministic way to outlive any kill budget).
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+
+def main() -> None:
+    import pylops_mpi_tpu as pmt
+    from pylops_mpi_tpu.ops.local import MatrixMult
+    from pylops_mpi_tpu.solvers.segmented import cgls_segmented
+
+    rng = np.random.default_rng(7)  # fixed: every process, same system
+    nblk = 8
+    n = int(os.environ.get("SEG_NBLOCK", "48"))
+    niter = int(os.environ.get("SEG_NITER", "40"))
+    epoch = int(os.environ.get("SEG_EPOCH", "5"))
+    sleep_s = float(os.environ.get("SEG_EPOCH_SLEEP", "0"))
+    ckpt = os.environ.get("SEG_CKPT") or None
+
+    mats = [rng.standard_normal((n, n)) for _ in range(nblk)]
+    Op = pmt.MPIBlockDiag([MatrixMult(m, dtype=np.float64)
+                           for m in mats])
+    xtrue = rng.standard_normal(nblk * n)
+    y = np.concatenate([m @ xtrue[i * n:(i + 1) * n]
+                        for i, m in enumerate(mats)])
+    dy = pmt.DistributedArray.to_dist(y)
+    x0 = pmt.DistributedArray.to_dist(np.zeros(nblk * n))
+
+    resumed = bool(ckpt and os.path.exists(ckpt))
+
+    def on_epoch(info):
+        if sleep_s:
+            time.sleep(sleep_s)
+
+    res = cgls_segmented(Op, dy, x0, niter=niter, tol=0.0, epoch=epoch,
+                         checkpoint_path=ckpt, on_epoch=on_epoch)
+    xs = np.ascontiguousarray(np.asarray(res.x.asarray()))
+    print(json.dumps({
+        "iiter": res.iiter, "status": res.status, "epochs": res.epochs,
+        "resumed": resumed,
+        "x_hash": hashlib.sha256(xs.tobytes()).hexdigest()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -121,6 +121,23 @@ def probe(timeout: int = 120) -> tuple:
     return _bench_mod()._tpu_probe(timeout)
 
 
+def _with_spawn_retry(name: str, stage_fn):
+    """Wrap a stage so transient SPAWN failures — ``OSError`` from
+    fork/exec of the stage subprocess (fd exhaustion, a momentarily
+    unwritable tmpdir) — get the resilience layer's bounded
+    retry/backoff instead of charging a dead stage to the window.
+    In-stage failures are the stage's own (result, err) verdict and
+    are never retried; without the package the wrapper is a no-op."""
+    def call(timeout):
+        try:
+            from pylops_mpi_tpu.resilience.retry import retry_call
+        except Exception:
+            return stage_fn(timeout)
+        return retry_call(stage_fn, timeout, exceptions=(OSError,),
+                          describe=f"stage {name} spawn")
+    return call
+
+
 def _stage_selfcheck(env, timeout):
     return _bench_mod()._run_json_cmd(
         [sys.executable, os.path.join(_HERE, "tpu_selfcheck.py")], env,
@@ -371,6 +388,7 @@ def harvest(cache: dict, rehearse: bool = False,
                 prev.get("code_rev") == rev:
             continue  # harvested on an earlier window, same code
         budget = _budget(name, rehearse=rehearse)
+        stage_fn = _with_spawn_retry(name, stage_fn)
         if runner is not None:
             rec = runner.run(name, stage_fn, budget)
             if rec.get("skipped"):

@@ -61,7 +61,24 @@ PAGES = {
         ("Basic", "pylops_mpi_tpu",
          ["cg", "cgls", "CG", "CGLS", "clear_fused_cache"]),
         ("Sparsity", "pylops_mpi_tpu", ["ista", "fista", "ISTA", "FISTA"]),
+        ("Guarded (explicit status word)", "pylops_mpi_tpu.solvers",
+         ["cg_guarded", "cgls_guarded", "ista_guarded", "fista_guarded"]),
+        ("Segmented (checkpoint/resume)", "pylops_mpi_tpu",
+         ["cg_segmented", "cgls_segmented"]),
         ("Eigenvalues", "pylops_mpi_tpu", ["power_iteration"]),
+    ],
+    "resilience": [
+        ("Status word and guards", "pylops_mpi_tpu.resilience.status",
+         ["status_name", "guards_mode", "guards_enabled", "stall_window",
+          "last_status"]),
+        ("Escalation driver", "pylops_mpi_tpu.resilience",
+         ["resilient_solve", "ResilientResult"]),
+        ("Bounded retry", "pylops_mpi_tpu.resilience.retry",
+         ["retry_call", "default_retries", "default_backoff_s"]),
+        ("Fault injection (chaos seams)",
+         "pylops_mpi_tpu.resilience.faults",
+         ["arm", "disarm", "armed", "consume", "fault_signature",
+          "corrupt_plan_cache", "flaky"]),
     ],
     "local": [
         ("Local (per-shard) operators", "pylops_mpi_tpu.ops.local",
@@ -89,7 +106,8 @@ PAGES = {
           "assert_ring_schedule", "count_host_callbacks",
           "assert_no_host_callbacks"]),
         ("Checkpointing", "pylops_mpi_tpu.utils.checkpoint",
-         ["save_solver", "load_solver"]),
+         ["save_solver", "load_solver", "save_fused_carry",
+          "load_fused_carry"]),
         ("FFT helpers", "pylops_mpi_tpu.utils.fft_helper",
          ["fftshift_nd", "ifftshift_nd"]),
         ("Decorators", "pylops_mpi_tpu.utils.decorators", ["reshaped"]),
@@ -153,6 +171,7 @@ PAGE_TITLES = {
     "local": "Local operators and kernels",
     "utils": "Utilities",
     "diagnostics": "Diagnostics and observability",
+    "resilience": "Resilience and fault injection",
     "tuning": "Autotuning",
     "models": "Model workflows",
 }

@@ -36,11 +36,14 @@ from .ops.fredholm import MPIFredholm1
 from .ops.mdc import MPIMDC
 from .solvers.basic import CG, CGLS, cg, cgls, clear_fused_cache
 from .solvers.sparsity import ISTA, FISTA, ista, fista
+from .solvers.segmented import cg_segmented, cgls_segmented
 from .solvers.eigs import power_iteration
+from .resilience import resilient_solve
 from .utils.dottest import dottest
 from .plotting.plotting import plot_distributed_array, plot_local_arrays
 
 from . import diagnostics
+from . import resilience
 from . import ops
 from . import solvers
 from . import utils

@@ -46,7 +46,8 @@ import jax.numpy as jnp
 
 __all__ = ["PrecisionPolicy", "get_policy", "set_precision",
            "default_compute_dtype", "reduction_dtype", "accum_dtype",
-           "donation_enabled", "einsum_narrow", "check_compute_dtype"]
+           "donation_enabled", "einsum_narrow", "check_compute_dtype",
+           "escalate_dtype", "effective_compute_dtype"]
 
 
 class PrecisionPolicy(NamedTuple):
@@ -148,6 +149,39 @@ def accum_dtype(dtype) -> np.dtype:
     if jnp.issubdtype(dt, jnp.floating) and dt.itemsize < 4:
         return np.dtype(np.float32)
     return dt
+
+
+# One-rung escalation ladder for the resilience layer (ISSUE 6):
+# a solve that breaks down under narrow storage restarts one rung
+# wider — the smallest precision change that can fix a narrow-storage
+# breakdown, so the fast path is surrendered in the smallest possible
+# steps (bf16 → f32 → f64, c64 → c128).
+_ESCALATION = {"bfloat16": np.dtype(np.float32),
+               "float16": np.dtype(np.float32),
+               "float32": np.dtype(np.float64),
+               "complex64": np.dtype(np.complex128)}
+
+
+def escalate_dtype(dtype) -> Optional[np.dtype]:
+    """The next-wider storage/compute dtype, or ``None`` at the top of
+    the ladder. The f64/c128 rung exists only when x64 is enabled —
+    without it the "wider" operator would silently run at f32 and the
+    restart would be a lie."""
+    name = jnp.dtype(dtype).name
+    nxt = _ESCALATION.get(name)
+    if nxt is None:
+        return None
+    if nxt.itemsize >= 8 and not jax.config.jax_enable_x64:
+        return None
+    return nxt
+
+
+def effective_compute_dtype(Op) -> np.dtype:
+    """The dtype an operator's matrix tiles actually live at: its
+    resolved ``compute_dtype`` when it has one (operators resolve the
+    env policy at construction), else its operator dtype."""
+    cdt = getattr(Op, "compute_dtype", None)
+    return np.dtype(cdt) if cdt is not None else np.dtype(Op.dtype)
 
 
 def donation_enabled() -> bool:

@@ -97,7 +97,9 @@ def make_mesh_2d(
 
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
-                         process_id: Optional[int] = None) -> None:
+                         process_id: Optional[int] = None,
+                         retries: Optional[int] = None,
+                         backoff_s: Optional[float] = None) -> None:
     """Join a multi-host TPU job (DCN-connected slices / pods).
 
     The analog of the reference's ``mpiexec -n P`` bootstrap + NCCL
@@ -105,11 +107,23 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     calls this once before building meshes; afterwards ``jax.devices()``
     spans every host and all collectives ride ICI within a slice and DCN
     across slices. Arguments default to the standard cluster env vars
-    (``jax.distributed.initialize`` auto-detection on TPU pods)."""
+    (``jax.distributed.initialize`` auto-detection on TPU pods).
+
+    Bring-up is the flakiest moment of a pod job — the coordinator may
+    not be listening yet, a preempted peer may rejoin late — so the
+    init runs under the bounded retry/backoff of
+    :func:`pylops_mpi_tpu.resilience.retry.retry_call`
+    (``PYLOPS_MPI_TPU_RETRIES`` / ``PYLOPS_MPI_TPU_RETRY_BACKOFF``;
+    per-call ``retries=``/``backoff_s=`` override). The final failure
+    propagates unchanged."""
     import jax.distributed
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    from ..resilience.retry import retry_call
+    retry_call(jax.distributed.initialize,
+               coordinator_address=coordinator_address,
+               num_processes=num_processes,
+               process_id=process_id,
+               retries=retries, backoff_s=backoff_s,
+               describe="jax.distributed.initialize")
 
 
 def make_mesh_hybrid(ici_axis: str = SP_AXIS, dcn_axis: str = "dcn",

@@ -1,0 +1,32 @@
+"""Resilient solver runtime (ISSUE 6).
+
+Four pieces, layered over the fused solvers:
+
+- :mod:`.status` — the in-loop status word
+  (``converged``/``maxiter``/``breakdown``/``stagnation``) and the
+  ``PYLOPS_MPI_TPU_GUARDS`` gate (off-mode traces bit-identical
+  programs).
+- :mod:`.driver` — :func:`resilient_solve`: precision-escalation
+  restarts from the last finite iterate (bf16 → f32 → f64).
+- :mod:`.retry` — bounded retry/backoff for transient host-side
+  faults (multihost init, harvest stage spawn).
+- :mod:`.faults` — the chaos seams that prove all of the above end to
+  end (in-loop NaN/stall injection, plan-cache corruption, flaky
+  callables).
+
+Segmented checkpoint/resume lives with the solvers
+(:mod:`pylops_mpi_tpu.solvers.segmented`) and the carry schema in
+:mod:`pylops_mpi_tpu.utils.checkpoint`. See ``docs/robustness.md``.
+"""
+
+from . import faults, retry, status
+from .status import (RUNNING, CONVERGED, MAXITER, BREAKDOWN, STAGNATION,
+                     status_name, guards_mode, guards_enabled,
+                     last_status)
+from .retry import retry_call
+from .driver import resilient_solve, ResilientResult
+
+__all__ = ["faults", "retry", "status", "RUNNING", "CONVERGED",
+           "MAXITER", "BREAKDOWN", "STAGNATION", "status_name",
+           "guards_mode", "guards_enabled", "last_status", "retry_call",
+           "resilient_solve", "ResilientResult"]

@@ -1,0 +1,145 @@
+"""``resilient_solve`` — graceful degradation by precision escalation.
+
+The narrow-storage fast path (ops/_precision.py, ISSUE 2) buys its
+HBM-roofline wins with headroom: a bf16-stored operator can underflow a
+denominator or overflow a recurrence scalar that the same system at f32
+absorbs. The guarded fused solvers (ISSUE 6, solvers/basic.py) turn
+that event into a ``BREAKDOWN`` status and a **last finite iterate**;
+this driver turns it into a finished solve:
+
+1. run the guarded fused solver at the current precision rung;
+2. on ``breakdown``/``stagnation``, rebuild the operator ONE rung wider
+   (``ops/_precision.escalate_dtype``: bf16 → f32 → f64, c64 → c128)
+   and restart **from the last finite iterate** with the remaining
+   iteration budget;
+3. bounded by ``max_restarts`` (``PYLOPS_MPI_TPU_RESTARTS``, default
+   2); every restart emits a structured ``solver.restart`` trace event.
+
+The caller supplies an **operator factory** ``make_op(compute_dtype)``
+(``compute_dtype=None`` on the first rung — the operator resolves the
+env precision policy itself, exactly as a direct construction would),
+because operators capture their storage dtype at construction; passing
+a plain operator instead disables escalation (restarts are then only
+possible for ``stagnation``, at the same precision, which is usually
+futile — the driver stops instead).
+
+Tuned plans survive restarts for free: the plan cache key
+(tuning/plan.py) carries the dtype, so each rung replays its own plan
+and invalidates nothing.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import namedtuple
+from typing import Callable, Optional, Sequence, Union
+
+import numpy as np
+
+from ..diagnostics import trace as _trace
+from . import status as _rstatus
+
+__all__ = ["resilient_solve", "ResilientResult", "max_restarts_default"]
+
+ResilientResult = namedtuple(
+    "ResilientResult",
+    ["x", "status", "iiter", "restarts", "compute_dtype", "cost",
+     "attempts"])
+ResilientResult.__doc__ = (
+    "Outcome of a resilient solve: the final iterate, the final status "
+    "NAME (``converged``/``maxiter``/``breakdown``/``stagnation``), "
+    "total iterations across every attempt, the restart count, the "
+    "compute dtype of the last attempt, its cost history, and a "
+    "per-attempt record list (precision, iterations, status).")
+
+_SOLVERS = ("cg", "cgls", "ista", "fista")
+
+
+def max_restarts_default() -> int:
+    """``PYLOPS_MPI_TPU_RESTARTS`` (default 2, floored at 0)."""
+    try:
+        v = int(os.environ.get("PYLOPS_MPI_TPU_RESTARTS", "2"))
+    except ValueError:
+        v = 2
+    return max(0, v)
+
+
+def _run_guarded(solver: str, Op, y, x, niter: int, tol: float,
+                 damp: float, solver_kwargs: dict):
+    from ..solvers.basic import cg_guarded, cgls_guarded
+    from ..solvers.sparsity import ista_guarded, fista_guarded
+    if solver == "cg":
+        xn, it, cost, code = cg_guarded(Op, y, x, niter=niter, tol=tol)
+    elif solver == "cgls":
+        xn, it, cost, _, _, code = cgls_guarded(
+            Op, y, x, niter=niter, damp=damp, tol=tol,
+            normal=bool(solver_kwargs.get("normal", False)))
+    else:
+        if x is None:
+            from ..solvers.basic import _zero_like_model
+            x = _zero_like_model(Op, y)
+        fn = ista_guarded if solver == "ista" else fista_guarded
+        kw = {k: v for k, v in solver_kwargs.items() if k != "normal"}
+        xn, it, cost, code = fn(Op, y, x, niter=niter, tol=tol, **kw)
+    return xn, it, cost, code
+
+
+def resilient_solve(make_op: Union[Callable, object], y, x0=None, *,
+                    solver: str = "cgls", niter: int = 100,
+                    tol: float = 1e-4, damp: float = 0.0,
+                    max_restarts: Optional[int] = None,
+                    precisions: Optional[Sequence] = None,
+                    **solver_kwargs) -> ResilientResult:
+    """Solve with in-loop breakdown detection and bounded
+    precision-escalation restarts (module docstring).
+
+    ``make_op`` — operator factory ``make_op(compute_dtype)`` (or a
+    plain operator, escalation disabled). ``precisions`` — explicit
+    rung sequence of compute dtypes for attempts after the first
+    (default: one :func:`~pylops_mpi_tpu.ops._precision.escalate_dtype`
+    rung per restart). Extra ``solver_kwargs`` reach the guarded sparse
+    solvers (``eps``, ``alpha``, ``threshkind``, ...) or CGLS
+    (``normal``)."""
+    from ..ops._precision import effective_compute_dtype, escalate_dtype
+    if solver not in _SOLVERS:
+        raise ValueError(f"solver={solver!r}: expected one of {_SOLVERS}")
+    if max_restarts is None:
+        max_restarts = max_restarts_default()
+    factory = make_op if callable(make_op) else None
+    ladder = list(precisions) if precisions is not None else None
+
+    x = x0
+    cdt = None  # first rung: the operator's own (policy-resolved) dtype
+    restarts = 0
+    total_iiter = 0
+    attempts = []
+    cost = None
+    while True:
+        Op = factory(cdt) if factory is not None else make_op
+        eff = effective_compute_dtype(Op)
+        remaining = max(1, niter - total_iiter)
+        x, it, cost, code = _run_guarded(solver, Op, y, x, remaining,
+                                         tol, damp, solver_kwargs)
+        total_iiter += it
+        attempts.append({"compute_dtype": eff.name, "iiter": it,
+                         "status": _rstatus.status_name(code)})
+        if code in (_rstatus.CONVERGED, _rstatus.MAXITER):
+            break
+        # breakdown / stagnation: escalate one rung and restart from
+        # the last finite iterate
+        if ladder is not None:
+            nxt = np.dtype(ladder.pop(0)) if ladder else None
+        else:
+            nxt = escalate_dtype(eff)
+        if factory is None or nxt is None or restarts >= max_restarts:
+            break
+        restarts += 1
+        _trace.event("solver.restart", cat="resilience", solver=solver,
+                     status=_rstatus.status_name(code),
+                     at_iter=total_iiter, restart=restarts,
+                     from_dtype=eff.name, to_dtype=nxt.name)
+        cdt = nxt
+    return ResilientResult(x=x, status=_rstatus.status_name(code),
+                           iiter=total_iiter, restarts=restarts,
+                           compute_dtype=eff.name, cost=cost,
+                           attempts=attempts)

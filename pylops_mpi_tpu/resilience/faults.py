@@ -1,0 +1,171 @@
+"""Fault-injection (chaos) seams.
+
+Every recovery path in the resilience layer is only as real as the
+fault that exercises it. This module is the controlled way to break
+things, used by the chaos suite (``tests/test_resilience.py``) to prove
+each detector and recovery end to end:
+
+- **In-loop NaN injection** (:func:`arm`, kind ``"nan"``): the guarded
+  fused builders consult :func:`armed` at trace time and, when a fault
+  is armed, multiply the first operator application of the loop body by
+  ``where(iiter == k, NaN, 1)`` — a NaN lands in the matvec result at
+  exactly the chosen iteration, the way a flaky interconnect or a DMA
+  bit-flip would deliver one. Nothing is traced when nothing is armed
+  (the bit-identity pins stay valid), and the fused-solver cache keys
+  on :func:`fault_signature` so a poisoned executable can never be
+  replayed for a clean solve.
+- **In-loop stall injection** (kind ``"stall"``): zeroes the step
+  scalar from the chosen iteration on — the recurrence freezes at a
+  non-converged residual, which is exactly the signature the
+  stagnation detector must catch.
+- **Plan-cache corruption** (:func:`corrupt_plan_cache`): truncates /
+  garbles a tuning-cache JSON mid-file, the artifact a killed writer
+  would have left before the atomic-rename hardening. ``tuning/cache``
+  must degrade to cost-model plans, never raise.
+- **Flaky callables** (:func:`flaky`): wraps a function to raise for
+  its first N calls (default ``TimeoutError`` — the simulated
+  collective/coordinator timeout), the probe for
+  :mod:`pylops_mpi_tpu.resilience.retry` and the multihost
+  ``jax.distributed`` init path.
+
+Faults are armed per-process and (by default) **one-shot**: the first
+guarded solve that traces consumes the fault, so a restart ladder sees
+the fault exactly once — the injected-breakdown-then-clean-restart
+scenario of the ISSUE 6 acceptance test.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+__all__ = ["arm", "disarm", "armed", "consume", "fault_signature",
+           "inject_nan", "inject_stall", "corrupt_plan_cache", "flaky"]
+
+_LOCK = threading.Lock()
+_ARMED: Optional[Dict] = None
+_KINDS = ("nan", "stall")
+
+
+def arm(kind: str, iteration: int, once: bool = True) -> None:
+    """Arm an in-loop fault: ``kind="nan"`` poisons the first operator
+    application of the loop body at ``iteration`` (0-based body-entry
+    count); ``kind="stall"`` zeroes the step scalar from ``iteration``
+    on. ``once=True`` (default) disarms after the next guarded solve
+    consumes it."""
+    if kind not in _KINDS:
+        raise ValueError(f"unknown fault kind {kind!r}; "
+                         f"expected one of {_KINDS}")
+    if iteration < 0:
+        raise ValueError(f"iteration must be >= 0, got {iteration}")
+    global _ARMED
+    with _LOCK:
+        _ARMED = {"kind": kind, "iteration": int(iteration),
+                  "once": bool(once)}
+
+
+def disarm() -> None:
+    global _ARMED
+    with _LOCK:
+        _ARMED = None
+
+
+def armed() -> Optional[Dict]:
+    """The armed fault spec (a copy), or ``None``."""
+    with _LOCK:
+        return dict(_ARMED) if _ARMED else None
+
+
+def consume() -> Optional[Dict]:
+    """Read-and-maybe-disarm: the guarded solver entry points call this
+    ONCE per solve, before building the fused program — the returned
+    spec parameterizes that program, and a one-shot fault is disarmed
+    so the next solve (e.g. the restart after the injected breakdown)
+    traces clean."""
+    global _ARMED
+    with _LOCK:
+        spec = dict(_ARMED) if _ARMED else None
+        if spec and spec.get("once"):
+            _ARMED = None
+    return spec
+
+
+def fault_signature(spec: Optional[Dict] = None):
+    """Hashable compile-relevant fault state for the fused-solver
+    cache key (same pattern as the telemetry/donation gates)."""
+    if spec is None:
+        spec = armed()
+    if not spec:
+        return ("faults", None)
+    return ("faults", spec["kind"], spec["iteration"])
+
+
+# ------------------------------------------------ traced injection ops
+def inject_nan(v, iiter, at: int):
+    """Multiply a (possibly stacked) distributed vector by
+    ``where(iiter == at, NaN, 1)`` — traced into the guarded loop body
+    at the operator-apply seam. The scalar is real, so complex carries
+    keep their dtype (solvers/basic.py ``_step_scalar`` promotion
+    rule)."""
+    import jax.numpy as jnp
+    import numpy as np
+    dt = np.dtype(v.dtype)
+    sdt = np.finfo(dt).dtype if jnp.issubdtype(dt, jnp.complexfloating) \
+        else dt
+    scale = jnp.where(jnp.asarray(iiter) == at,
+                      jnp.asarray(jnp.nan, dtype=sdt),
+                      jnp.asarray(1.0, dtype=sdt))
+    return v * scale
+
+
+def inject_stall(a, iiter, at: int):
+    """Zero the step scalar from iteration ``at`` on: the iterate and
+    residual stop moving while the loop keeps spinning — the
+    stagnation detector's target signature."""
+    import jax.numpy as jnp
+    return jnp.where(jnp.asarray(iiter) >= at, jnp.zeros_like(a), a)
+
+
+# -------------------------------------------------- host-side chaos
+def corrupt_plan_cache(path: str, mode: str = "truncate") -> None:
+    """Damage a tuning-cache JSON the way a killed writer or a bad
+    disk would: ``truncate`` cuts the file mid-object, ``garbage``
+    replaces it with non-JSON bytes, ``schema`` rewrites it with a
+    wrong schema version. ``tuning/cache.load_plans`` must treat every
+    variant as a logged miss."""
+    import json
+    import os
+    if mode == "truncate":
+        with open(path, "r+") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.truncate(max(1, size // 2))
+    elif mode == "garbage":
+        with open(path, "w") as f:
+            f.write("\x00\xff not json at all {{{")
+    elif mode == "schema":
+        with open(path, "w") as f:
+            json.dump({"schema": -1, "plans": {}}, f)
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+
+
+def flaky(fn: Callable, failures: int,
+          exc: Callable[[], BaseException] = None) -> Callable:
+    """Wrap ``fn`` to raise for its first ``failures`` calls, then
+    delegate — the simulated collective/coordinator timeout. ``exc``
+    builds the exception (default ``TimeoutError``). The wrapper
+    exposes ``.calls`` for assertions."""
+    if exc is None:
+        exc = lambda: TimeoutError("injected timeout")  # noqa: E731
+    state = {"calls": 0}
+
+    def wrapper(*args, **kwargs):
+        state["calls"] += 1
+        wrapper.calls = state["calls"]
+        if state["calls"] <= failures:
+            raise exc()
+        return fn(*args, **kwargs)
+
+    wrapper.calls = 0
+    return wrapper

@@ -1,0 +1,87 @@
+"""Bounded retry with exponential backoff.
+
+Multi-host bring-up is the flakiest moment of a pod job: the
+``jax.distributed`` coordinator may not be listening yet, a DNS entry
+may lag the pod scheduler, a preempted peer may rejoin seconds late.
+The reference stack leans on ``mpiexec`` to re-run the world; here one
+controller process must absorb transient faults itself. This module is
+the ONE retry/backoff implementation, used by
+:func:`pylops_mpi_tpu.parallel.mesh.initialize_multihost` and by the
+harvest ladder's stage spawn (``benchmarks/tpu_probe_loop.py``) — both
+places where the failure is transient-by-construction and a bounded
+retry is the difference between a lost window and a banked result.
+
+Retries are **bounded** (``PYLOPS_MPI_TPU_RETRIES``, default 3 extra
+attempts) with doubling backoff from
+``PYLOPS_MPI_TPU_RETRY_BACKOFF`` seconds (default 0.5, capped at 30 s
+per sleep); every retry emits a structured ``resilience.retry`` trace
+event so a flaky-but-recovering init is visible in the JSONL artifact
+instead of silently eating minutes. The final failure re-raises the
+last exception unchanged — retry must never LAUNDER an error.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from ..diagnostics import trace as _trace
+
+__all__ = ["retry_call", "default_retries", "default_backoff_s"]
+
+_MAX_SLEEP_S = 30.0
+
+
+def default_retries() -> int:
+    """``PYLOPS_MPI_TPU_RETRIES`` (default 3, floored at 0 — 0 means
+    one attempt, no retries)."""
+    try:
+        v = int(os.environ.get("PYLOPS_MPI_TPU_RETRIES", "3"))
+    except ValueError:
+        v = 3
+    return max(0, v)
+
+
+def default_backoff_s() -> float:
+    """``PYLOPS_MPI_TPU_RETRY_BACKOFF`` initial sleep in seconds
+    (default 0.5, floored at 0 for tests that must not sleep)."""
+    try:
+        v = float(os.environ.get("PYLOPS_MPI_TPU_RETRY_BACKOFF", "0.5"))
+    except ValueError:
+        v = 0.5
+    return max(0.0, v)
+
+
+def retry_call(fn: Callable, *args,
+               retries: Optional[int] = None,
+               backoff_s: Optional[float] = None,
+               exceptions: Tuple[Type[BaseException], ...] = (Exception,),
+               describe: str = "call",
+               sleep: Callable[[float], None] = time.sleep,
+               **kwargs):
+    """Call ``fn(*args, **kwargs)``; on an exception from
+    ``exceptions``, sleep (doubling backoff, capped) and retry up to
+    ``retries`` more times. Emits one ``resilience.retry`` trace event
+    per retry; the last failure propagates unchanged.
+
+    ``sleep`` is injectable so the chaos tests don't wait out real
+    backoffs."""
+    retries = default_retries() if retries is None else max(0, retries)
+    backoff = default_backoff_s() if backoff_s is None else max(0.0,
+                                                                backoff_s)
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except exceptions as e:
+            attempt += 1
+            if attempt > retries:
+                raise
+            wait = min(backoff * (2 ** (attempt - 1)), _MAX_SLEEP_S)
+            _trace.event("resilience.retry", cat="resilience",
+                         what=describe, attempt=attempt,
+                         retries=retries, backoff_s=round(wait, 3),
+                         error=repr(e)[:200])
+            if wait > 0:
+                sleep(wait)

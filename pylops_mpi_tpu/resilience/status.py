@@ -1,0 +1,155 @@
+"""Solver status word + in-loop guard gating.
+
+The fused CG/CGLS/ISTA/FISTA solvers run their whole iteration as one
+``lax.while_loop`` — which also means a numerical breakdown (NaN from a
+flaky interconnect, a bf16 denominator underflow, a stalled recurrence)
+is invisible until the loop burns through every remaining iteration and
+returns garbage. The resilience layer (ISSUE 6) adds a **status word**
+to the fused carries, computed entirely from the recurrence scalars the
+loops already hold — zero host callbacks, pinned by
+``utils/hlo.assert_no_host_callbacks`` in guards-on mode:
+
+- ``CONVERGED`` / ``MAXITER`` — the two normal exits, resolved on
+  device after the loop.
+- ``BREAKDOWN`` — NaN/Inf in a recurrence scalar (``k``, step ``a``,
+  momentum ``b``, sparse cost) or a denominator underflow (``kold`` or
+  ``qᵀq`` collapsing to 0 turns the next ratio into Inf). The loop
+  exits on the NEXT ``cond`` evaluation and the carry keeps the **last
+  finite iterate**: the poisoned update is rejected with a
+  ``jnp.where`` select, so ``resilient_solve`` can restart from it.
+- ``STAGNATION`` — the best residual norm has not improved for
+  ``PYLOPS_MPI_TPU_GUARD_STALL`` consecutive iterations (the
+  machine-precision freeze documented in ``solvers/basic._mp_floor``
+  is excluded — a solve parked at the floor is done, not sick).
+
+Gating — ``PYLOPS_MPI_TPU_GUARDS``:
+
+- ``off`` (default): the fused builders trace EXACTLY the pre-guard
+  program — bit-identical lowered HLO, pinned by the resilience suite.
+- ``on``: the guard carries and selects are traced in; the solve can
+  exit early with a diagnosable status.
+
+The public ``cg``/``cgls``/``ista``/``fista`` wrappers keep their
+return signatures in both modes; the status of the most recent guarded
+solve is published here (:func:`record` / :func:`last_status`) and as a
+``solver.status`` trace event, and the guarded entry points
+(``solvers.basic.cg_guarded`` etc.) return the code explicitly for the
+:func:`pylops_mpi_tpu.resilience.resilient_solve` driver.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+from ..diagnostics import trace as _trace
+
+__all__ = ["RUNNING", "CONVERGED", "MAXITER", "BREAKDOWN", "STAGNATION",
+           "STATUS_NAMES", "status_name", "guards_mode", "guards_enabled",
+           "stall_window", "guards_signature", "record", "last_status",
+           "clear_statuses"]
+
+# in-carry status word values (int32 scalars inside the while_loop)
+RUNNING = 0
+CONVERGED = 1
+MAXITER = 2
+BREAKDOWN = 3
+STAGNATION = 4
+
+STATUS_NAMES = {RUNNING: "running", CONVERGED: "converged",
+                MAXITER: "maxiter", BREAKDOWN: "breakdown",
+                STAGNATION: "stagnation"}
+
+_warned_mode = False
+
+
+def status_name(code) -> str:
+    """Human name for a status code (unknown codes print as
+    ``status<code>`` rather than raising — a diagnostic must never
+    crash the thing it is diagnosing)."""
+    return STATUS_NAMES.get(int(code), f"status{int(code)}")
+
+
+def guards_mode() -> str:
+    """``PYLOPS_MPI_TPU_GUARDS`` resolved to ``off``/``on`` (unknown
+    values fall back to ``off`` with a one-time warning — a typo in a
+    CI matrix must not silently change traced programs)."""
+    global _warned_mode
+    m = os.environ.get("PYLOPS_MPI_TPU_GUARDS", "off").strip().lower()
+    if m in ("", "0", "none", "default"):
+        m = "off"
+    if m in ("1", "true"):
+        m = "on"
+    if m not in ("off", "on"):
+        if not _warned_mode:
+            import warnings
+            warnings.warn(
+                f"PYLOPS_MPI_TPU_GUARDS={m!r} is not one of "
+                "['off', 'on']; guards stay off", stacklevel=2)
+            _warned_mode = True
+        m = "off"
+    return m
+
+
+def guards_enabled(user=None) -> bool:
+    """Resolve the guard gate: a per-call ``guards=`` kwarg
+    (``True``/``False``; ``None`` defers to the env) beats
+    ``PYLOPS_MPI_TPU_GUARDS`` — same precedence rule as the overlap
+    and precision seams."""
+    if isinstance(user, bool):
+        return user
+    if user is not None:
+        raise ValueError(f"guards={user!r}: expected True, False or None")
+    return guards_mode() == "on"
+
+
+def stall_window() -> int:
+    """Stagnation window ``PYLOPS_MPI_TPU_GUARD_STALL`` (default 50,
+    floored at 2 — a window of 1 would flag every non-monotone CG
+    step, and CG residual norms are legitimately non-monotone)."""
+    try:
+        v = int(os.environ.get("PYLOPS_MPI_TPU_GUARD_STALL", "50"))
+    except ValueError:
+        v = 50
+    return max(2, v)
+
+
+def guards_signature(user=None):
+    """Compile-relevant guard state for the fused-solver cache key: a
+    program traced with the guard carries embedded must never be
+    reused when the gate is off (and vice versa), and a different
+    stall window is a different traced constant."""
+    on = guards_enabled(user)
+    return ("guards", on, stall_window() if on else None)
+
+
+# ------------------------------------------------- last-status channel
+# The public solver wrappers keep their return signatures when guards
+# are on; the status word of the most recent guarded solve per solver
+# name lands here (and as a solver.status trace event).
+_LOCK = threading.Lock()
+_LAST: Dict[str, Dict] = {}
+
+
+def record(solver: str, code: int, iiter: int) -> None:
+    info = {"status": int(code), "status_name": status_name(code),
+            "iiter": int(iiter)}
+    with _LOCK:
+        _LAST[solver] = info
+    _trace.event("solver.status", cat="resilience", solver=solver, **info)
+
+
+def last_status(solver: str) -> Optional[Dict]:
+    """Status record of the most recent guarded solve for ``solver``
+    (``"cg"``/``"cgls"``/``"ista"``/``"fista"``), or ``None`` if no
+    guarded solve has run."""
+    with _LOCK:
+        info = _LAST.get(solver)
+        return dict(info) if info else None
+
+
+def clear_statuses() -> None:
+    """Test-isolation helper."""
+    with _LOCK:
+        _LAST.clear()

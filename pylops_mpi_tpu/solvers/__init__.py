@@ -1,3 +1,5 @@
-from .basic import CG, CGLS, cg, cgls, clear_fused_cache
-from .sparsity import ISTA, FISTA, ista, fista
+from .basic import (CG, CGLS, cg, cgls, cg_guarded, cgls_guarded,
+                    clear_fused_cache)
+from .sparsity import ISTA, FISTA, ista, fista, ista_guarded, fista_guarded
+from .segmented import cg_segmented, cgls_segmented, SegmentedResult
 from .eigs import power_iteration

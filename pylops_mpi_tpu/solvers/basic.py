@@ -37,7 +37,8 @@ from ..distributedarray import DistributedArray
 from ..stacked import StackedDistributedArray
 from ..diagnostics import telemetry, trace as _trace
 
-__all__ = ["CG", "CGLS", "cg", "cgls", "clear_fused_cache"]
+__all__ = ["CG", "CGLS", "cg", "cgls", "cg_guarded", "cgls_guarded",
+           "clear_fused_cache"]
 
 Vector = Union[DistributedArray, StackedDistributedArray]
 
@@ -283,41 +284,149 @@ class CGLS(_BaseSolver):
 # the builders bind the carry as ``x = x0`` (a traced ``x0.copy()``
 # would be exactly the copy-of-donated-state the HLO pin forbids —
 # tests/test_precision.py::test_fused_cgls_donation).
+#
+# In-loop guards (ISSUE 6): every builder takes a static ``guards``
+# flag. ``guards=False`` (the default, and the only mode when
+# ``PYLOPS_MPI_TPU_GUARDS`` is off) traces EXACTLY the pre-guard
+# program — bit-identical lowered HLO, pinned by the resilience
+# suite. ``guards=True`` appends a ``(status, bestk, stall)`` guard
+# carry computed purely from the recurrence scalars the loop already
+# holds (zero host callbacks): NaN/Inf in the step/momentum/norm
+# scalars or a denominator underflow reject the poisoned update (the
+# carry keeps the LAST FINITE iterate) and exit with
+# ``status=BREAKDOWN``; ``stall_n`` iterations without a new best
+# residual exit with ``status=STAGNATION`` (the machine-precision
+# freeze below is excluded — parked at the floor is done, not sick).
 _DONATE_X0 = (1,)
 
 
-def _cg_fused(Op, y: Vector, x0: Vector, tol, *, niter: int):
-    """Whole CG solve as one ``lax.while_loop`` (SURVEY §3.2: the
-    reference's hot loop does 4 host-synced allreduces per iteration —
-    here everything fuses into a single XLA program). Recurrence
-    scalars accumulate at the policy reduction dtype (``_rdot``) and
-    re-enter vector updates at the carry dtype (``_step_scalar``) so
-    the carry pytree dtypes are identical at iteration 1 and k."""
-    xdt = _vdtype(x0)
+def _i32(v):
+    return jnp.asarray(v, dtype=jnp.int32)
+
+
+def _reject(bad, old, new):
+    """``old`` where ``bad`` else ``new``, elementwise over a
+    (possibly stacked) distributed vector — the guard carries keep the
+    last finite iterate by rejecting a poisoned update wholesale
+    (scaling the step to zero would not do: ``NaN * 0`` is ``NaN``)."""
+    if isinstance(new, StackedDistributedArray):
+        return StackedDistributedArray(
+            [_reject(bad, o, n)
+             for o, n in zip(old.distarrays, new.distarrays)])
+    return DistributedArray._wrap(jnp.where(bad, old._arr, new._arr), new)
+
+
+def _guard_update(status, bestk, stall, bad, k, done, stall_n: int):
+    """One guard-carry step, shared by every guarded body: breakdown
+    beats stagnation; the stall counter only runs while the recurrence
+    is live (not poisoned, not frozen at the machine-precision
+    floor)."""
+    from ..resilience import status as _rstatus
+    kmax = jnp.max(k)
+    improved = (kmax < bestk) & ~bad
+    frozen = jnp.all(done)
+    stall = jnp.where(bad | frozen, stall,
+                      jnp.where(improved, jnp.zeros_like(stall),
+                                stall + 1))
+    bestk = jnp.where(improved, kmax, bestk)
+    status = jnp.where(bad, _i32(_rstatus.BREAKDOWN),
+                       jnp.where(stall >= stall_n,
+                                 _i32(_rstatus.STAGNATION), status))
+    return status, bestk, stall
+
+
+def _resolve_status(status, kold, tol):
+    """Post-loop status resolution (still on device): a loop that
+    exited without a guard verdict either converged or ran out of
+    iterations."""
+    from ..resilience import status as _rstatus
+    return jnp.where(
+        status != _rstatus.RUNNING, status,
+        jnp.where(jnp.max(kold) <= tol, _i32(_rstatus.CONVERGED),
+                  _i32(_rstatus.MAXITER)))
+
+
+def _fault_sites(guards: bool, fault):
+    """Static (nan_at, stall_at) injection iterations for a guarded
+    body — both ``None`` (nothing traced) unless a chaos fault is
+    armed (resilience/faults.py)."""
+    if not guards or not fault:
+        return None, None
+    if fault.get("kind") == "nan":
+        return fault["iteration"], None
+    if fault.get("kind") == "stall":
+        return None, fault["iteration"]
+    return None, None
+
+
+def _make_cg_body(Op, xdt, floors, *, guards=False, carry_status=False,
+                  stall_n=0, fault=None):
+    """CG loop body over the carry ``(x, r, c, kold, iiter, cost
+    [, status][, bestk, stall])`` — the one implementation behind the
+    single-shot fused loop, the guarded variant and the segmented
+    epoch program. ``carry_status`` threads the status word without
+    the detectors (the segmented path always carries it so resumed
+    epochs keep one pytree)."""
+    from ..resilience import faults as _faults
+    nan_at, stall_at = _fault_sites(guards, fault)
 
     def body(state):
-        x, r, c, kold, iiter, cost = state
+        if guards:
+            x, r, c, kold, iiter, cost, status, bestk, stall = state
+        elif carry_status:
+            x, r, c, kold, iiter, cost, status = state
+        else:
+            x, r, c, kold, iiter, cost = state
         done = kold <= floors
         Opc = Op.matvec(c)
+        if nan_at is not None:
+            Opc = _faults.inject_nan(Opc, iiter, nan_at)
         a = kold / _rdot(c, Opc)
         a = jnp.where(done, jnp.zeros_like(a), a)
-        x = x + c * _step_scalar(a, xdt)
-        r = r - Opc * _step_scalar(a, xdt)
-        k = _rdot(r, r)
+        if stall_at is not None:
+            a = _faults.inject_stall(a, iiter, stall_at)
+        xn = x + c * _step_scalar(a, xdt)
+        rn = r - Opc * _step_scalar(a, xdt)
+        k = _rdot(rn, rn)
         k = jnp.where(done, kold, k)
         b = jnp.where(done, jnp.zeros_like(k), k / kold)
-        c = r + c * _step_scalar(b, xdt)
+        cn = rn + c * _step_scalar(b, xdt)
+        if guards:
+            bad = (jnp.any(~jnp.isfinite(a)) | jnp.any(~jnp.isfinite(k))
+                   | jnp.any(~jnp.isfinite(b)))
+            x = _reject(bad, x, xn)
+            r = _reject(bad, r, rn)
+            c = _reject(bad, c, cn)
+            k = jnp.where(bad, kold, k)
+            status, bestk, stall = _guard_update(status, bestk, stall,
+                                                 bad, k, done, stall_n)
+        else:
+            x, r, c = xn, rn, cn
         iiter = iiter + 1
         cost = lax.dynamic_update_index_in_dim(cost, jnp.sqrt(k), iiter, 0)
         # no-op unless telemetry is enabled (PYLOPS_MPI_TPU_TRACE=full):
         # disabled builds trace NOTHING here — the zero-host-callback pin
         telemetry.iteration("cg", iiter, resid=jnp.sqrt(k), k=k, alpha=a)
+        if guards:
+            return (x, r, c, k, iiter, cost, status, bestk, stall)
+        if carry_status:
+            return (x, r, c, k, iiter, cost, status)
         return (x, r, c, k, iiter, cost)
 
-    def cond(state):
-        _, _, _, kold, iiter, _ = state
-        return (iiter < niter) & (jnp.max(kold) > tol)
+    return body
 
+
+def _cg_fused(Op, y: Vector, x0: Vector, tol, *, niter: int,
+              guards: bool = False, stall_n: int = 0, fault=None):
+    """Whole CG solve as one ``lax.while_loop`` (SURVEY §3.2: the
+    reference's hot loop does 4 host-synced allreduces per iteration —
+    here everything fuses into a single XLA program). Recurrence
+    scalars accumulate at the policy reduction dtype (``_rdot``) and
+    re-enter vector updates at the carry dtype (``_step_scalar``) so
+    the carry pytree dtypes are identical at iteration 1 and k.
+    ``guards=True`` returns an extra status word (see the section
+    comment above)."""
+    xdt = _vdtype(x0)
     x = x0  # donated: the carry aliases the caller's buffer in place
     r = y - Op.matvec(x)
     c = r
@@ -325,59 +434,214 @@ def _cg_fused(Op, y: Vector, x0: Vector, tol, *, niter: int):
     floors = _mp_floor(kold)
     cost0 = jnp.zeros((niter + 1,) + jnp.shape(kold), dtype=jnp.asarray(kold).dtype)
     cost0 = lax.dynamic_update_index_in_dim(cost0, jnp.sqrt(kold), 0, 0)
+    body = _make_cg_body(Op, xdt, floors, guards=guards,
+                         stall_n=stall_n, fault=fault)
+    if guards:
+        from ..resilience import status as _rstatus
+        state = (x, r, c, kold, jnp.asarray(0), cost0,
+                 _i32(_rstatus.RUNNING), jnp.max(kold), _i32(0))
+
+        def cond(state):
+            return ((state[4] < niter) & (jnp.max(state[3]) > tol)
+                    & (state[6] == _rstatus.RUNNING))
+
+        x, r, c, kold, iiter, cost, status, _, _ = \
+            lax.while_loop(cond, body, state)
+        return x, iiter, cost, _resolve_status(status, kold, tol)
+
+    def cond(state):
+        _, _, _, kold, iiter, _ = state
+        return (iiter < niter) & (jnp.max(kold) > tol)
+
     state = (x, r, c, kold, jnp.asarray(0), cost0)
     x, r, c, kold, iiter, cost = lax.while_loop(cond, body, state)
     return x, iiter, cost
 
 
-def _cgls_fused(Op, y: Vector, x0: Vector, damp, tol, *, niter: int):
-    damp2 = damp ** 2
-    xdt = _vdtype(x0)
+def _make_cgls_body(Op, xdt, damp2, floors, *, normal=False,
+                    guards=False, carry_status=False, stall_n=0,
+                    fault=None):
+    """CGLS loop body (classic two-sweep or fused-normal) over the
+    carry ``(x, s, c, q, ...)`` / ``(x, s, r, c, ...)`` — shared by the
+    single-shot loops, the guarded variants and the segmented epoch
+    program (solvers/segmented.py)."""
+    from ..resilience import faults as _faults
+    nan_at, stall_at = _fault_sites(guards, fault)
 
-    def body(state):
-        x, s, c, q, kold, iiter, cost, cost1 = state
+    def body_classic(state):
+        if guards:
+            x, s, c, q, kold, iiter, cost, cost1, status, bestk, stall \
+                = state
+        elif carry_status:
+            x, s, c, q, kold, iiter, cost, cost1, status = state
+        else:
+            x, s, c, q, kold, iiter, cost, cost1 = state
         done = kold <= floors
         a = _abs(kold / (_rdot(q, q) + damp2 * _rdot(c, c)))
         a = jnp.where(done, jnp.zeros_like(a), a)
-        x = x + c * _step_scalar(a, xdt)
-        s = s - q * _step_scalar(a, xdt)
-        r = Op.rmatvec(s) - x * damp2
+        if stall_at is not None:
+            a = _faults.inject_stall(a, iiter, stall_at)
+        xn = x + c * _step_scalar(a, xdt)
+        sn_ = s - q * _step_scalar(a, xdt)
+        r = Op.rmatvec(sn_) - xn * damp2
         k = _rdot(r, r)
         k = jnp.where(done, kold, k)
         b = jnp.where(done, jnp.zeros_like(k), k / kold)
-        c = r + c * _step_scalar(b, xdt)
-        q = Op.matvec(c)
+        cn = r + c * _step_scalar(b, xdt)
+        qn = Op.matvec(cn)
+        if nan_at is not None:
+            qn = _faults.inject_nan(qn, iiter, nan_at)
+        if guards:
+            bad = (jnp.any(~jnp.isfinite(a)) | jnp.any(~jnp.isfinite(k))
+                   | jnp.any(~jnp.isfinite(b)))
+            x = _reject(bad, x, xn)
+            s = _reject(bad, s, sn_)
+            c = _reject(bad, c, cn)
+            q = _reject(bad, q, qn)
+            k = jnp.where(bad, kold, k)
+            status, bestk, stall = _guard_update(status, bestk, stall,
+                                                 bad, k, done, stall_n)
+        else:
+            x, s, c, q = xn, sn_, cn, qn
         iiter = iiter + 1
         sn = jnp.asarray(s.norm())
         cost = lax.dynamic_update_index_in_dim(cost, sn, iiter, 0)
         r2 = jnp.sqrt(sn ** 2 + damp2 * _rdot(x, x))
         cost1 = lax.dynamic_update_index_in_dim(cost1, r2, iiter, 0)
-        # no-op unless telemetry is enabled (see _cg_fused note)
+        # no-op unless telemetry is enabled (see _make_cg_body note)
         telemetry.iteration("cgls", iiter, resid=sn, k=k, alpha=a)
+        if guards:
+            return (x, s, c, q, k, iiter, cost, cost1, status, bestk,
+                    stall)
+        if carry_status:
+            return (x, s, c, q, k, iiter, cost, cost1, status)
         return (x, s, c, q, k, iiter, cost, cost1)
 
-    def cond(state):
-        return (state[5] < niter) & (jnp.max(state[4]) > tol)
+    def body_normal(state):
+        if guards:
+            x, s, r, c, kold, iiter, cost, cost1, status, bestk, stall \
+                = state
+        elif carry_status:
+            x, s, r, c, kold, iiter, cost, cost1, status = state
+        else:
+            x, s, r, c, kold, iiter, cost, cost1 = state
+        done = kold <= floors
+        u, q = Op.normal_matvec(c)
+        if nan_at is not None:
+            u = _faults.inject_nan(u, iiter, nan_at)
+            q = _faults.inject_nan(q, iiter, nan_at)
+        a = _abs(kold / (_rdot(q, q) + damp2 * _rdot(c, c)))
+        a = jnp.where(done, jnp.zeros_like(a), a)
+        if stall_at is not None:
+            a = _faults.inject_stall(a, iiter, stall_at)
+        xn = x + c * _step_scalar(a, xdt)
+        sn_ = s - q * _step_scalar(a, xdt)
+        rn = r - (u + c * damp2) * _step_scalar(a, xdt)
+        k = _rdot(rn, rn)
+        k = jnp.where(done, kold, k)
+        b = jnp.where(done, jnp.zeros_like(k), k / kold)
+        cn = rn + c * _step_scalar(b, xdt)
+        if guards:
+            bad = (jnp.any(~jnp.isfinite(a)) | jnp.any(~jnp.isfinite(k))
+                   | jnp.any(~jnp.isfinite(b)))
+            x = _reject(bad, x, xn)
+            s = _reject(bad, s, sn_)
+            r = _reject(bad, r, rn)
+            c = _reject(bad, c, cn)
+            k = jnp.where(bad, kold, k)
+            status, bestk, stall = _guard_update(status, bestk, stall,
+                                                 bad, k, done, stall_n)
+        else:
+            x, s, r, c = xn, sn_, rn, cn
+        iiter = iiter + 1
+        sn = jnp.asarray(s.norm())
+        cost = lax.dynamic_update_index_in_dim(cost, sn, iiter, 0)
+        r2 = jnp.sqrt(sn ** 2 + damp2 * _rdot(x, x))
+        cost1 = lax.dynamic_update_index_in_dim(cost1, r2, iiter, 0)
+        # no-op unless telemetry is enabled (see _make_cg_body note)
+        telemetry.iteration("cgls", iiter, resid=sn, k=k, alpha=a)
+        if guards:
+            return (x, s, r, c, k, iiter, cost, cost1, status, bestk,
+                    stall)
+        if carry_status:
+            return (x, s, r, c, k, iiter, cost, cost1, status)
+        return (x, s, r, c, k, iiter, cost, cost1)
 
+    return body_normal if normal else body_classic
+
+
+def _cgls_setup(Op, y: Vector, x0: Vector, damp, damp2, *, niter: int,
+                normal: bool):
+    """Shared CGLS prologue: residuals, first direction, recurrence
+    norm, machine-precision floor and the cost buffers — used by the
+    single-shot fused loops here and the segmented driver
+    (solvers/segmented.py), which must seed the exact same carry."""
     x = x0  # donated: carry aliases the caller's buffer (see _DONATE_X0)
     s = y - Op.matvec(x)
-    r = Op.rmatvec(s) - x * damp  # ref's un-squared setup damp
-    c = r
-    q = Op.matvec(c)
-    kold = _rdot(r, r)
+    rq = Op.rmatvec(s) - x * damp  # ref's un-squared setup damp (see
+    c = rq                         # module doc) seeds only the first
+    if not normal:                 # direction, as in the classic path
+        q = Op.matvec(c)
+    kold = _rdot(rq, rq)
     floors = _mp_floor(kold)
+    if normal:
+        # the recurrence tracks the true gradient r = Opᴴs − damp²x, so
+        # it must start from the damp²-form, not the quirked one
+        r = rq + x * (damp - damp2)
     sn0 = jnp.asarray(s.norm())
     cost0 = jnp.zeros((niter + 1,) + jnp.shape(sn0), dtype=sn0.dtype)
     cost0 = lax.dynamic_update_index_in_dim(cost0, sn0, 0, 0)
     cost1_0 = lax.dynamic_update_index_in_dim(
         jnp.zeros_like(cost0),
         jnp.sqrt(sn0 ** 2 + damp2 * _rdot(x, x)), 0, 0)
-    state = (x, s, c, q, kold, jnp.asarray(0), cost0, cost1_0)
-    x, s, c, q, kold, iiter, cost, cost1 = lax.while_loop(cond, body, state)
-    return x, iiter, cost, cost1, kold
+    if normal:
+        return (x, s, r, c, kold), floors, cost0, cost1_0
+    return (x, s, c, q, kold), floors, cost0, cost1_0
 
 
-def _cgls_fused_normal(Op, y: Vector, x0: Vector, damp, tol, *, niter: int):
+def _cgls_fused_any(Op, y: Vector, x0: Vector, damp, tol, *, niter: int,
+                    normal: bool, guards: bool, stall_n: int = 0,
+                    fault=None):
+    damp2 = damp ** 2
+    xdt = _vdtype(x0)
+    head, floors, cost0, cost1_0 = _cgls_setup(Op, y, x0, damp, damp2,
+                                               niter=niter, normal=normal)
+    body = _make_cgls_body(Op, xdt, damp2, floors, normal=normal,
+                           guards=guards, stall_n=stall_n, fault=fault)
+    if guards:
+        from ..resilience import status as _rstatus
+        kold0 = head[4]
+        state = head + (jnp.asarray(0), cost0, cost1_0,
+                        _i32(_rstatus.RUNNING), jnp.max(kold0), _i32(0))
+
+        def cond(state):
+            return ((state[5] < niter) & (jnp.max(state[4]) > tol)
+                    & (state[8] == _rstatus.RUNNING))
+
+        out = lax.while_loop(cond, body, state)
+        x, kold, iiter, cost, cost1, status = (out[0], out[4], out[5],
+                                               out[6], out[7], out[8])
+        return (x, iiter, cost, cost1, kold,
+                _resolve_status(status, kold, tol))
+
+    def cond(state):
+        return (state[5] < niter) & (jnp.max(state[4]) > tol)
+
+    state = head + (jnp.asarray(0), cost0, cost1_0)
+    out = lax.while_loop(cond, body, state)
+    return out[0], out[5], out[6], out[7], out[4]
+
+
+def _cgls_fused(Op, y: Vector, x0: Vector, damp, tol, *, niter: int,
+                guards: bool = False, stall_n: int = 0, fault=None):
+    return _cgls_fused_any(Op, y, x0, damp, tol, niter=niter,
+                           normal=False, guards=guards, stall_n=stall_n,
+                           fault=fault)
+
+
+def _cgls_fused_normal(Op, y: Vector, x0: Vector, damp, tol, *,
+                       niter: int, guards: bool = False,
+                       stall_n: int = 0, fault=None):
     """CGLS with one operator memory sweep per iteration: the step uses
     ``(u, q) = Op.normal_matvec(c)`` (``u = OpᴴOp c`` computed in the
     same pass that yields ``q = Op c``) and the gradient recurrence
@@ -385,52 +649,9 @@ def _cgls_fused_normal(Op, y: Vector, x0: Vector, damp, tol, *, niter: int):
     textbook ``r = Opᴴ s − damp² x`` (s-update substituted). Halves HBM
     traffic on memory-bound matvecs; enabled when
     ``Op.has_fused_normal``."""
-    damp2 = damp ** 2
-    xdt = _vdtype(x0)
-
-    def body(state):
-        x, s, r, c, kold, iiter, cost, cost1 = state
-        done = kold <= floors
-        u, q = Op.normal_matvec(c)
-        a = _abs(kold / (_rdot(q, q) + damp2 * _rdot(c, c)))
-        a = jnp.where(done, jnp.zeros_like(a), a)
-        x = x + c * _step_scalar(a, xdt)
-        s = s - q * _step_scalar(a, xdt)
-        r = r - (u + c * damp2) * _step_scalar(a, xdt)
-        k = _rdot(r, r)
-        k = jnp.where(done, kold, k)
-        b = jnp.where(done, jnp.zeros_like(k), k / kold)
-        c = r + c * _step_scalar(b, xdt)
-        iiter = iiter + 1
-        sn = jnp.asarray(s.norm())
-        cost = lax.dynamic_update_index_in_dim(cost, sn, iiter, 0)
-        r2 = jnp.sqrt(sn ** 2 + damp2 * _rdot(x, x))
-        cost1 = lax.dynamic_update_index_in_dim(cost1, r2, iiter, 0)
-        # no-op unless telemetry is enabled (see _cg_fused note)
-        telemetry.iteration("cgls", iiter, resid=sn, k=k, alpha=a)
-        return (x, s, r, c, k, iiter, cost, cost1)
-
-    def cond(state):
-        return (state[5] < niter) & (jnp.max(state[4]) > tol)
-
-    x = x0  # donated: carry aliases the caller's buffer (see _DONATE_X0)
-    s = y - Op.matvec(x)
-    rq = Op.rmatvec(s) - x * damp  # ref's un-squared setup damp (see
-    c = rq                         # module doc) seeds only the first
-    kold = _rdot(rq, rq)            # direction, as in the classic path
-    floors = _mp_floor(kold)
-    # the recurrence tracks the true gradient r = Opᴴs − damp²x, so it
-    # must start from the damp²-form, not the quirked one
-    r = rq + x * (damp - damp2)
-    sn0 = jnp.asarray(s.norm())
-    cost0 = jnp.zeros((niter + 1,) + jnp.shape(sn0), dtype=sn0.dtype)
-    cost0 = lax.dynamic_update_index_in_dim(cost0, sn0, 0, 0)
-    cost1_0 = lax.dynamic_update_index_in_dim(
-        jnp.zeros_like(cost0),
-        jnp.sqrt(sn0 ** 2 + damp2 * _rdot(x, x)), 0, 0)
-    state = (x, s, r, c, kold, jnp.asarray(0), cost0, cost1_0)
-    x, s, r, c, kold, iiter, cost, cost1 = lax.while_loop(cond, body, state)
-    return x, iiter, cost, cost1, kold
+    return _cgls_fused_any(Op, y, x0, damp, tol, niter=niter,
+                           normal=True, guards=guards, stall_n=stall_n,
+                           fault=fault)
 
 
 # Bounded LRU of compiled fused solvers. The operator itself is stored
@@ -516,12 +737,46 @@ def _donate_copy(v: Vector) -> Vector:
     return v.copy() if donation_enabled() else v
 
 
+def _run_cg_fused(Op, y: Vector, x0: Vector, x0_owned: bool, niter: int,
+                  tol, guards: bool):
+    """Compile-cache-and-run the fused CG loop. Returns ``(x, iiter,
+    cost, status_code)`` — ``status_code`` is ``None`` on the unguarded
+    path (whose traced program is bit-identical to the pre-guard
+    build; the guard carries only exist under ``guards=True``)."""
+    if guards:
+        from ..resilience import faults as _faults, status as _rstatus
+        spec = _faults.consume()
+        stall_n = _rstatus.stall_window()
+        fn = _get_fused(Op, (id(Op), "cg", niter, _vkey(y), _vkey(x0),
+                             _rstatus.guards_signature(True),
+                             _faults.fault_signature(spec)),
+                        lambda op: partial(_cg_fused, op, niter=niter,
+                                           guards=True, stall_n=stall_n,
+                                           fault=spec),
+                        donate_argnums=_DONATE_X0)
+        x, iiter, cost, status = fn(
+            y, x0 if x0_owned else _donate_copy(x0), tol)
+        iiter, code = int(iiter), int(status)
+        _rstatus.record("cg", code, iiter)
+        return x, iiter, np.asarray(cost)[:iiter + 1], code
+    fn = _get_fused(Op, (id(Op), "cg", niter, _vkey(y), _vkey(x0)),
+                    lambda op: partial(_cg_fused, op, niter=niter),
+                    donate_argnums=_DONATE_X0)
+    x, iiter, cost = fn(y, x0 if x0_owned else _donate_copy(x0), tol)
+    iiter = int(iiter)
+    return x, iiter, np.asarray(cost)[:iiter + 1], None
+
+
 def cg(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
        tol: float = 1e-4, show: bool = False, itershow=(10, 10, 10),
-       callback: Optional[Callable] = None, fused: Optional[bool] = None
-       ) -> Tuple[Vector, int, np.ndarray]:
+       callback: Optional[Callable] = None, fused: Optional[bool] = None,
+       guards: Optional[bool] = None) -> Tuple[Vector, int, np.ndarray]:
     """Functional CG (ref ``optimization/basic.py:13-70``). With no
-    callback/show, runs the fused on-device loop."""
+    callback/show, runs the fused on-device loop. ``guards`` resolves
+    against ``PYLOPS_MPI_TPU_GUARDS`` (resilience/status.py): guarded
+    fused solves can exit early on breakdown/stagnation — the return
+    signature is unchanged, the status word lands in
+    ``resilience.status.last_status("cg")``."""
     x0_owned = x0 is None  # freshly built → donate without a copy
     if x0 is None:
         x0 = _zero_like_model(Op, y)
@@ -529,18 +784,16 @@ def cg(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
     if use_fused and (callback is not None or show):
         raise ValueError("fused=True cannot honor callback/show; use "
                          "fused=False for per-iteration hooks")
+    from ..resilience.status import guards_enabled
+    use_guards = use_fused and guards_enabled(guards)
     with _trace.span("solver.cg", cat="solver", op=type(Op).__name__,
                      shape=Op.shape, dtype=_vdtype(x0), niter=niter,
-                     tol=tol, fused=use_fused,
+                     tol=tol, fused=use_fused, guards=use_guards,
                      telemetry=telemetry.telemetry_enabled()):
         if use_fused:
-            fn = _get_fused(Op, (id(Op), "cg", niter, _vkey(y), _vkey(x0)),
-                            lambda op: partial(_cg_fused, op, niter=niter),
-                            donate_argnums=_DONATE_X0)
-            x, iiter, cost = fn(y, x0 if x0_owned else _donate_copy(x0),
-                                tol)
-            iiter = int(iiter)
-            return x, iiter, np.asarray(cost)[:iiter + 1]
+            x, iiter, cost, _ = _run_cg_fused(Op, y, x0, x0_owned,
+                                              niter, tol, use_guards)
+            return x, iiter, cost
         solver = CG(Op)
         solver._callback_wrap(callback)
         x, iiter, cost = solver.solve(y, x0, niter=niter, tol=tol,
@@ -548,16 +801,73 @@ def cg(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
         return x, iiter, cost
 
 
+def cg_guarded(Op, y: Vector, x0: Optional[Vector] = None,
+               niter: int = 10, tol: float = 1e-4):
+    """Guarded fused CG with an explicit status word: returns
+    ``(x, iiter, cost, status_code)`` where the code is one of
+    ``resilience.status.{CONVERGED, MAXITER, BREAKDOWN, STAGNATION}``.
+    On breakdown ``x`` is the last finite iterate — the restart seed
+    for :func:`pylops_mpi_tpu.resilience.resilient_solve`."""
+    x0_owned = x0 is None
+    if x0 is None:
+        x0 = _zero_like_model(Op, y)
+    with _trace.span("solver.cg", cat="solver", op=type(Op).__name__,
+                     shape=Op.shape, dtype=_vdtype(x0), niter=niter,
+                     tol=tol, fused=True, guards=True,
+                     telemetry=telemetry.telemetry_enabled()):
+        return _run_cg_fused(Op, y, x0, x0_owned, niter, tol, True)
+
+
+def _run_cgls_fused(Op, y: Vector, x0: Vector, x0_owned: bool,
+                    niter: int, damp, tol, use_normal: bool,
+                    guards: bool):
+    """Compile-cache-and-run the fused CGLS loop; see
+    :func:`_run_cg_fused` for the guard/status contract. Returns
+    ``(x, iiter, cost, cost1, kold, status_code_or_None)``."""
+    builder = _cgls_fused_normal if use_normal else _cgls_fused
+    if guards:
+        from ..resilience import faults as _faults, status as _rstatus
+        spec = _faults.consume()
+        stall_n = _rstatus.stall_window()
+        fn = _get_fused(Op, (id(Op), "cgls", use_normal, niter,
+                             _vkey(y), _vkey(x0),
+                             _rstatus.guards_signature(True),
+                             _faults.fault_signature(spec)),
+                        lambda op: partial(builder, op, niter=niter,
+                                           guards=True, stall_n=stall_n,
+                                           fault=spec),
+                        donate_argnums=_DONATE_X0)
+        x, iiter, cost, cost1, kold, status = fn(
+            y, x0 if x0_owned else _donate_copy(x0), damp, tol)
+        iiter, code = int(iiter), int(status)
+        _rstatus.record("cgls", code, iiter)
+        return (x, iiter, np.asarray(cost)[:iiter + 1],
+                np.asarray(cost1)[:iiter + 1], kold, code)
+    fn = _get_fused(Op, (id(Op), "cgls", use_normal, niter,
+                         _vkey(y), _vkey(x0)),
+                    lambda op: partial(builder, op, niter=niter),
+                    donate_argnums=_DONATE_X0)
+    x, iiter, cost, cost1, kold = fn(
+        y, x0 if x0_owned else _donate_copy(x0), damp, tol)
+    iiter = int(iiter)
+    return (x, iiter, np.asarray(cost)[:iiter + 1],
+            np.asarray(cost1)[:iiter + 1], kold, None)
+
+
 def cgls(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
          damp: float = 0.0, tol: float = 1e-4, show: bool = False,
          itershow=(10, 10, 10), callback: Optional[Callable] = None,
-         fused: Optional[bool] = None, normal: Optional[bool] = None):
+         fused: Optional[bool] = None, normal: Optional[bool] = None,
+         guards: Optional[bool] = None):
     """Functional CGLS (ref ``optimization/basic.py:73-148``).
 
     ``normal=True`` selects the one-sweep normal-equations iteration
     (``_cgls_fused_normal``) — fastest on memory-bound operators that
     provide a fused ``normal_matvec`` (e.g. batched MPIBlockDiag), but
-    its gradient recurrence drifts slightly in f32, so it is opt-in."""
+    its gradient recurrence drifts slightly in f32, so it is opt-in.
+    ``guards`` resolves against ``PYLOPS_MPI_TPU_GUARDS`` (see
+    :func:`cg`); the status word lands in
+    ``resilience.status.last_status("cgls")``."""
     x0_owned = x0 is None  # freshly built → donate without a copy
     if x0 is None:
         x0 = _zero_like_model(Op, y)
@@ -569,28 +879,41 @@ def cgls(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
     if use_normal and not use_fused:
         raise ValueError("normal=True requires the fused path; drop "
                          "callback/show or pass fused=True")
+    from ..resilience.status import guards_enabled
+    use_guards = use_fused and guards_enabled(guards)
     with _trace.span("solver.cgls", cat="solver", op=type(Op).__name__,
                      shape=Op.shape, dtype=_vdtype(x0), niter=niter,
                      damp=damp, tol=tol, fused=use_fused,
-                     normal=use_normal,
+                     normal=use_normal, guards=use_guards,
                      telemetry=telemetry.telemetry_enabled()):
         if use_fused:
-            builder = _cgls_fused_normal if use_normal else _cgls_fused
-            fn = _get_fused(Op, (id(Op), "cgls", use_normal, niter,
-                                 _vkey(y), _vkey(x0)),
-                            lambda op: partial(builder, op, niter=niter),
-                            donate_argnums=_DONATE_X0)
-            x, iiter, cost, cost1, kold = fn(
-                y, x0 if x0_owned else _donate_copy(x0), damp, tol)
-            iiter = int(iiter)
+            x, iiter, cost, cost1, kold, _ = _run_cgls_fused(
+                Op, y, x0, x0_owned, niter, damp, tol, use_normal,
+                use_guards)
             istop = 1 if float(jnp.max(kold)) < tol else 2
-            cost = np.asarray(cost)[:iiter + 1]
-            cost1 = np.asarray(cost1)[:iiter + 1]
             return x, istop, iiter, kold, cost1[-1], cost
         solver = CGLS(Op)
         solver._callback_wrap(callback)
         return solver.solve(y, x0, niter=niter, damp=damp, tol=tol,
                             show=show, itershow=itershow)
+
+
+def cgls_guarded(Op, y: Vector, x0: Optional[Vector] = None,
+                 niter: int = 10, damp: float = 0.0, tol: float = 1e-4,
+                 normal: bool = False):
+    """Guarded fused CGLS with an explicit status word: returns
+    ``(x, iiter, cost, cost1, kold, status_code)``; see
+    :func:`cg_guarded` for the status contract."""
+    x0_owned = x0 is None
+    if x0 is None:
+        x0 = _zero_like_model(Op, y)
+    with _trace.span("solver.cgls", cat="solver", op=type(Op).__name__,
+                     shape=Op.shape, dtype=_vdtype(x0), niter=niter,
+                     damp=damp, tol=tol, fused=True,
+                     normal=bool(normal), guards=True,
+                     telemetry=telemetry.telemetry_enabled()):
+        return _run_cgls_fused(Op, y, x0, x0_owned, niter, damp, tol,
+                               bool(normal), True)
 
 
 def _vkey(v: Vector):

@@ -44,7 +44,8 @@ from ..stacked import StackedDistributedArray
 from ..diagnostics import telemetry, trace as _trace
 from .eigs import power_iteration
 
-__all__ = ["ISTA", "FISTA", "ista", "fista"]
+__all__ = ["ISTA", "FISTA", "ista", "fista", "ista_guarded",
+           "fista_guarded"]
 
 Vector = Union[DistributedArray, StackedDistributedArray]
 
@@ -256,7 +257,8 @@ class FISTA(ISTA):
 # --------------------------------------------------------- fused (on-device)
 def _ista_fused(Op, y: Vector, x0: Vector, alpha, eps, tol, decay,
                 *, niter: int, threshf: Callable, SOp=None,
-                momentum: bool = False):
+                momentum: bool = False, guards: bool = False,
+                stall_n: int = 0, fault=None):
     """Whole ISTA/FISTA solve as one ``lax.while_loop``. The eager class
     API pulls 3-4 host floats per iteration (xupdate, costdata, costreg,
     optionally normres); here every scalar stays on device and the
@@ -271,9 +273,19 @@ def _ista_fused(Op, y: Vector, x0: Vector, alpha, eps, tol, decay,
     every iteration — solvers/basic.py ``_step_scalar``): the decay /
     step / momentum scalars are pinned to the model space's REAL dtype
     so a float64 python scalar can never promote an f32 carry, and the
-    xupdate/cost scalars live at the policy reduction dtype."""
-    from .basic import _step_scalar, _vdtype
+    xupdate/cost scalars live at the policy reduction dtype.
+
+    ``guards=True`` (ISSUE 6) appends a ``(status, bestc, stall)``
+    guard carry — NaN/Inf in the cost or xupdate scalars reject the
+    poisoned update (the carry keeps the last finite iterate) and exit
+    with ``status=BREAKDOWN``; ``stall_n`` iterations without a new
+    best cost exit with ``status=STAGNATION``. ``guards=False`` traces
+    exactly the pre-guard program (bit-identity pin)."""
+    from .basic import (_step_scalar, _vdtype, _reject, _guard_update,
+                        _resolve_status, _i32, _fault_sites)
+    from ..resilience import faults as _faults
     from ..ops._precision import reduction_dtype
+    nan_at, stall_at = _fault_sites(guards, fault)
     xdt = _vdtype(x0)
     rdt = reduction_dtype(xdt)
     thresh = eps * alpha * 0.5
@@ -307,11 +319,19 @@ def _ista_fused(Op, y: Vector, x0: Vector, alpha, eps, tol, decay,
         return v
 
     def body(state):
-        x, z, t, iiter, cost, _ = state
+        if guards:
+            x, z, t, iiter, cost, _, status, bestc, stall = state
+        else:
+            x, z, t, iiter, cost, _ = state
         xin = z if momentum else x
-        res = y - Op.matvec(xin)
-        x_unthresh = xin + Op.rmatvec(res) * _step_scalar(
-            jnp.asarray(alpha, dtype=rdt), xdt)
+        mv = Op.matvec(xin)
+        if nan_at is not None:
+            mv = _faults.inject_nan(mv, iiter, nan_at)
+        res = y - mv
+        step = _step_scalar(jnp.asarray(alpha, dtype=rdt), xdt)
+        if stall_at is not None:
+            step = _faults.inject_stall(step, iiter, stall_at)
+        x_unthresh = xin + Op.rmatvec(res) * step
         if SOp is not None:
             x_unthresh = SOp.rmatvec(x_unthresh)
         xnew = threshold(x_unthresh, iiter)
@@ -329,16 +349,37 @@ def _ista_fused(Op, y: Vector, x0: Vector, alpha, eps, tol, decay,
             costdata = 0.5 * jnp.max(jnp.asarray(res.norm())) ** 2
         costreg = eps * jnp.max(jnp.asarray(xnew.norm(1)))
         xupdate = jnp.max(jnp.asarray((xnew - x).norm())).astype(rdt)
-        cost = lax.dynamic_update_index_in_dim(
-            cost, (costdata + costreg).astype(cost.dtype), iiter, 0)
+        costval = (costdata + costreg).astype(cost.dtype)
+        xnew = _relayout_like(x, xnew)
+        znew = _relayout_like(z, znew)
+        if guards:
+            bad = (jnp.any(~jnp.isfinite(costval))
+                   | jnp.any(~jnp.isfinite(xupdate)))
+            xnew = _reject(bad, x, xnew)
+            znew = _reject(bad, z, znew)
+            tnew = jnp.where(bad, t, tnew)
+            # a rejected step must not look converged: keep the loop
+            # exit decision on the status word, not a NaN-turned-zero
+            xupdate = jnp.where(bad, jnp.asarray(jnp.inf, dtype=rdt),
+                                xupdate)
+            status, bestc, stall = _guard_update(
+                status, bestc, stall, bad, costval,
+                jnp.zeros_like(bad), stall_n)
+        cost = lax.dynamic_update_index_in_dim(cost, costval, iiter, 0)
         # no-op unless telemetry is enabled (PYLOPS_MPI_TPU_TRACE=full)
         # — the disabled build traces NOTHING here (zero-callback pin)
         telemetry.iteration("fista" if momentum else "ista", iiter + 1,
                             cost=costdata + costreg, xupdate=xupdate)
-        return (_relayout_like(x, xnew), _relayout_like(z, znew), tnew,
-                iiter + 1, cost, xupdate)
+        if guards:
+            return (xnew, znew, tnew, iiter + 1, cost, xupdate, status,
+                    bestc, stall)
+        return (xnew, znew, tnew, iiter + 1, cost, xupdate)
 
     def cond(state):
+        if guards:
+            from ..resilience import status as _rstatus
+            return ((state[3] < niter) & (state[5] > tol)
+                    & (state[6] == _rstatus.RUNNING))
         return (state[3] < niter) & (state[5] > tol)
 
     x = x0          # donated: carry aliases the caller's buffer
@@ -347,12 +388,21 @@ def _ista_fused(Op, y: Vector, x0: Vector, alpha, eps, tol, decay,
     cost0 = jnp.zeros((niter,), dtype=t0.dtype)
     state = (x, z, t0, jnp.asarray(0), cost0,
              jnp.asarray(jnp.inf, dtype=rdt))
+    if guards:
+        from ..resilience import status as _rstatus
+        state = state + (_i32(_rstatus.RUNNING),
+                         jnp.asarray(jnp.inf, dtype=cost0.dtype),
+                         _i32(0))
+        out = lax.while_loop(cond, body, state)
+        x, iiter, cost, xupdate, status = (out[0], out[3], out[4],
+                                           out[5], out[6])
+        return x, iiter, cost, _resolve_status(status, xupdate, tol)
     x, z, t, iiter, cost, xupdate = lax.while_loop(cond, body, state)
     return x, iiter, cost
 
 
 def _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha, eigsdict, tol,
-                        threshkind, decay, momentum):
+                        threshkind, decay, momentum, guards=False):
     from .basic import _get_fused, _vkey, _donate_copy, _DONATE_X0
 
     if threshkind not in _THRESHF:
@@ -383,9 +433,28 @@ def _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha, eigsdict, tol,
             if len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
                 _FUSED_CACHE.popitem(last=False)
     decay = np.ones(niter) if decay is None else np.asarray(decay)
-    key = (id(Op), "fista" if momentum else "ista", niter, threshkind,
+    name = "fista" if momentum else "ista"
+    key = (id(Op), name, niter, threshkind,
            id(SOp) if SOp is not None else None, len(decay),
            _vkey(y), _vkey(x0))
+    if guards:
+        from ..resilience import faults as _faults, status as _rstatus
+        spec = _faults.consume()
+        stall_n = _rstatus.stall_window()
+        key = key + (_rstatus.guards_signature(True),
+                     _faults.fault_signature(spec))
+        fn = _get_fused(Op, key,
+                        lambda op: partial(_ista_fused, op, niter=niter,
+                                           threshf=_THRESHF[threshkind],
+                                           SOp=SOp, momentum=momentum,
+                                           guards=True, stall_n=stall_n,
+                                           fault=spec),
+                        donate_argnums=_DONATE_X0)
+        x, iiter, cost, status = fn(y, _donate_copy(x0), alpha, eps, tol,
+                                    jnp.asarray(decay))
+        iiter, code = int(iiter), int(status)
+        _rstatus.record(name, code, iiter)
+        return x, iiter, np.asarray(cost)[:iiter], code
     fn = _get_fused(Op, key,
                     lambda op: partial(_ista_fused, op, niter=niter,
                                        threshf=_THRESHF[threshkind],
@@ -402,14 +471,21 @@ def ista(Op, y: Vector, x0: Optional[Vector] = None,
          alpha: Optional[float] = None, eigsdict=None, tol: float = 1e-10,
          threshkind: str = "soft", perc=None, decay=None,
          monitorres: bool = False, show: bool = False, itershow=(10, 10, 10),
-         callback: Optional[Callable] = None, fused: Optional[bool] = None):
+         callback: Optional[Callable] = None, fused: Optional[bool] = None,
+         guards: Optional[bool] = None):
     """Functional ISTA (ref ``optimization/sparsity.py:11-133``). With no
-    callback/show/monitorres, runs the fused on-device loop."""
+    callback/show/monitorres, runs the fused on-device loop. ``guards``
+    resolves against ``PYLOPS_MPI_TPU_GUARDS`` (see
+    :func:`pylops_mpi_tpu.solvers.basic.cg`); the status word lands in
+    ``resilience.status.last_status("ista")``."""
     use_fused = fused if fused is not None else \
         (callback is None and not show and not monitorres and perc is None)
+    from ..resilience.status import guards_enabled
+    use_guards = use_fused and guards_enabled(guards)
     with _trace.span("solver.ista", cat="solver", op=type(Op).__name__,
                      shape=Op.shape, niter=niter, eps=eps,
                      threshkind=threshkind, fused=use_fused,
+                     guards=use_guards,
                      telemetry=telemetry.telemetry_enabled()):
         if use_fused:
             if callback is not None or show or monitorres:
@@ -418,9 +494,10 @@ def ista(Op, y: Vector, x0: Optional[Vector] = None,
             if perc is not None:
                 raise NotImplementedError(
                     "percentile thresholding is not implemented")
-            return _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha,
-                                       eigsdict, tol, threshkind, decay,
-                                       momentum=False)
+            out = _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha,
+                                      eigsdict, tol, threshkind, decay,
+                                      momentum=False, guards=use_guards)
+            return out[:3]
         solver = ISTA(Op)
         if callback is not None:
             solver.callback = callback
@@ -436,14 +513,20 @@ def fista(Op, y: Vector, x0: Optional[Vector] = None,
           alpha: Optional[float] = None, eigsdict=None, tol: float = 1e-10,
           threshkind: str = "soft", perc=None, decay=None,
           monitorres: bool = False, show: bool = False, itershow=(10, 10, 10),
-          callback: Optional[Callable] = None, fused: Optional[bool] = None):
+          callback: Optional[Callable] = None, fused: Optional[bool] = None,
+          guards: Optional[bool] = None):
     """Functional FISTA (ref ``optimization/sparsity.py:136-257``). With
-    no callback/show/monitorres, runs the fused on-device loop."""
+    no callback/show/monitorres, runs the fused on-device loop.
+    ``guards`` resolves against ``PYLOPS_MPI_TPU_GUARDS`` (see
+    :func:`ista`)."""
     use_fused = fused if fused is not None else \
         (callback is None and not show and not monitorres and perc is None)
+    from ..resilience.status import guards_enabled
+    use_guards = use_fused and guards_enabled(guards)
     with _trace.span("solver.fista", cat="solver", op=type(Op).__name__,
                      shape=Op.shape, niter=niter, eps=eps,
                      threshkind=threshkind, fused=use_fused,
+                     guards=use_guards,
                      telemetry=telemetry.telemetry_enabled()):
         if use_fused:
             if callback is not None or show or monitorres:
@@ -452,9 +535,10 @@ def fista(Op, y: Vector, x0: Optional[Vector] = None,
             if perc is not None:
                 raise NotImplementedError(
                     "percentile thresholding is not implemented")
-            return _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha,
-                                       eigsdict, tol, threshkind, decay,
-                                       momentum=True)
+            out = _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha,
+                                      eigsdict, tol, threshkind, decay,
+                                      momentum=True, guards=use_guards)
+            return out[:3]
         solver = FISTA(Op)
         if callback is not None:
             solver.callback = callback
@@ -463,3 +547,35 @@ def fista(Op, y: Vector, x0: Optional[Vector] = None,
                             threshkind=threshkind, perc=perc, decay=decay,
                             monitorres=monitorres, show=show,
                             itershow=itershow)
+
+
+def ista_guarded(Op, y: Vector, x0: Vector, niter: int = 10, SOp=None,
+                 eps: float = 0.1, alpha: Optional[float] = None,
+                 eigsdict=None, tol: float = 1e-10,
+                 threshkind: str = "soft", decay=None):
+    """Guarded fused ISTA with an explicit status word: returns
+    ``(x, iiter, cost, status_code)`` — the sparse-solver counterpart
+    of :func:`pylops_mpi_tpu.solvers.basic.cg_guarded`, consumed by
+    :func:`pylops_mpi_tpu.resilience.resilient_solve`."""
+    with _trace.span("solver.ista", cat="solver", op=type(Op).__name__,
+                     shape=Op.shape, niter=niter, eps=eps,
+                     threshkind=threshkind, fused=True, guards=True,
+                     telemetry=telemetry.telemetry_enabled()):
+        return _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha,
+                                   eigsdict, tol, threshkind, decay,
+                                   momentum=False, guards=True)
+
+
+def fista_guarded(Op, y: Vector, x0: Vector, niter: int = 10, SOp=None,
+                  eps: float = 0.1, alpha: Optional[float] = None,
+                  eigsdict=None, tol: float = 1e-10,
+                  threshkind: str = "soft", decay=None):
+    """Guarded fused FISTA with an explicit status word: returns
+    ``(x, iiter, cost, status_code)``; see :func:`ista_guarded`."""
+    with _trace.span("solver.fista", cat="solver", op=type(Op).__name__,
+                     shape=Op.shape, niter=niter, eps=eps,
+                     threshkind=threshkind, fused=True, guards=True,
+                     telemetry=telemetry.telemetry_enabled()):
+        return _sparse_fused_solve(Op, y, x0, niter, SOp, eps, alpha,
+                                   eigsdict, tol, threshkind, decay,
+                                   momentum=True, guards=True)

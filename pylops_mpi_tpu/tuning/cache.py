@@ -107,34 +107,74 @@ def lookup(key: str, path: Optional[str] = None) -> Optional[dict]:
     return load_plans(path).get(key)
 
 
+class _file_lock:
+    """Best-effort cross-process mutex around the read-merge-write
+    cycle (ISSUE 6 hardening): two concurrent writers — e.g. the
+    offline tuning CLI racing a live auto-tuning session — would each
+    read, merge only their own entry and atomically replace, silently
+    dropping the other's plan. An ``fcntl.flock`` on a ``.lock``
+    sidecar serializes the cycle; on platforms without ``fcntl`` the
+    lock degrades to a no-op (the write stays atomic and valid, a
+    concurrent entry may be lost — never the file)."""
+
+    def __init__(self, path: str):
+        self._path = path + ".lock"
+        self._fh = None
+
+    def __enter__(self):
+        try:
+            import fcntl
+            self._fh = open(self._path, "a")
+            fcntl.flock(self._fh.fileno(), fcntl.LOCK_EX)
+        except Exception:
+            if self._fh is not None:
+                self._fh.close()
+            self._fh = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._fh is not None:
+            try:
+                import fcntl
+                fcntl.flock(self._fh.fileno(), fcntl.LOCK_UN)
+            except Exception:
+                pass
+            self._fh.close()
+        return False
+
+
 def store(key: str, entry: dict, path: Optional[str] = None) -> None:
     """Bank ``entry`` under ``key``: always into the in-memory store;
     additionally read-merge-atomic-write the cache file when one is
-    configured. A failed file write is logged (trace event) and
-    swallowed — persistence is best-effort, the in-process plan is
-    already usable."""
+    configured — under a cross-process file lock so concurrent writers
+    merge instead of clobbering, through a pid-suffixed temp file so
+    two processes can never collide on the same staging name. A failed
+    file write is logged (trace event) and swallowed — persistence is
+    best-effort, the in-process plan is already usable."""
     with _LOCK:
         _MEM[key] = dict(entry)
     path = cache_path(path)
     if not path:
         return
     try:
-        plans = load_plans(path)
-        plans[key] = dict(entry)
-        doc = {"schema": SCHEMA_VERSION, "plans": plans}
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(prefix=".tune_cache_", dir=d)
-        try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(doc, f, indent=1, sort_keys=True)
-            os.replace(tmp, path)
-        finally:
-            if os.path.exists(tmp):
-                try:
-                    os.remove(tmp)
-                except OSError:
-                    pass
+        with _file_lock(os.path.abspath(path)):
+            plans = load_plans(path)
+            plans[key] = dict(entry)
+            doc = {"schema": SCHEMA_VERSION, "plans": plans}
+            fd, tmp = tempfile.mkstemp(
+                prefix=f".tune_cache_{os.getpid()}_", dir=d)
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(doc, f, indent=1, sort_keys=True)
+                os.replace(tmp, path)
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
     except Exception as e:  # persistence must never break the workload
         _trace.event("tuning.cache_error", cat="tuning", path=path,
                      why=f"write failed: {e!r}")

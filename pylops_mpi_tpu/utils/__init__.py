@@ -1,5 +1,6 @@
 from .dottest import dottest
 from .fft_helper import fftshift_nd, ifftshift_nd
 from .benchmark import benchmark, mark, profile_trace
-from .checkpoint import save_solver, load_solver, save_pytree, load_pytree
+from .checkpoint import (save_solver, load_solver, save_pytree,
+                         load_pytree, save_fused_carry, load_fused_carry)
 from .hlo import collective_report, assert_no_full_gather

@@ -28,15 +28,33 @@ import jax
 from ..distributedarray import DistributedArray, Partition
 from ..stacked import StackedDistributedArray
 
-__all__ = ["save_solver", "load_solver", "save_pytree", "load_pytree"]
+__all__ = ["save_solver", "load_solver", "save_pytree", "load_pytree",
+           "save_fused_carry", "load_fused_carry", "FUSED_SCHEMA_VERSION"]
 
 _SOLVER_FIELDS = ("y", "s", "r", "c", "q", "kold", "iiter", "cost", "cost1",
                   "damp", "tol", "niter", "t", "z", "alpha", "thresh",
                   "normresold", "eps")
 
 
+def _check_addressable(v: DistributedArray) -> None:
+    """The native backend gathers every shard to host (``asarray``) —
+    impossible on a multi-host pod, where each process can only address
+    its own slice's shards. Fail here with the fix in the message
+    instead of deep inside jax's cross-host gather."""
+    arr = getattr(v, "_arr", None)
+    if arr is not None and not getattr(arr, "is_fully_addressable", True):
+        raise RuntimeError(
+            "native checkpoint backend cannot gather a multi-host "
+            "DistributedArray: some shards are on non-addressable "
+            "devices (other hosts). Use the orbax backend — "
+            "save_*(..., backend='orbax') or "
+            "PYLOPS_MPI_TPU_CKPT_BACKEND=orbax — which writes each "
+            "host's shards locally with no gather (docs/multihost.md).")
+
+
 def _encode(v):
     if isinstance(v, DistributedArray):
+        _check_addressable(v)
         return {"__dist__": True, "value": v.asarray(),
                 "partition": v.partition.name, "axis": v.axis,
                 "local_shapes": v.local_shapes, "mask": v.mask}
@@ -322,3 +340,48 @@ def load_solver(path: str, solver, mesh=None,
     for k, v in state.items():
         setattr(solver, k, v)
     return x
+
+
+# ------------------------------------------------ fused-carry schema
+# Mid-solve snapshots of the SEGMENTED fused solvers
+# (solvers/segmented.py, ISSUE 6): the whole while_loop carry — the
+# distributed recurrence vectors plus the recurrence scalars, the
+# iteration counter, the cost buffers, the machine-precision floor and
+# the guard words — under a versioned header, so a killed process can
+# resume mid-solve and replay the remaining epochs bit-identically.
+FUSED_SCHEMA_VERSION = 1
+
+
+def save_fused_carry(path: str, solver: str, carry: Dict[str, Any],
+                     backend: Optional[str] = None) -> None:
+    """Snapshot a segmented fused solve's carry between epochs.
+    ``solver`` names the loop family (``"cg"``/``"cgls"``); ``carry``
+    is the field dict the segmented driver threads (plus its plan
+    metadata — ``niter``/``damp``/``tol``/``epoch``/``guards``), all of
+    which round-trips bit-exactly through either backend."""
+    state = dict(carry)
+    state["__fused__"] = solver
+    state["__fused_schema__"] = FUSED_SCHEMA_VERSION
+    save_pytree(path, state, backend=backend)
+
+
+def load_fused_carry(path: str, solver: str, mesh=None,
+                     backend: Optional[str] = None) -> Dict[str, Any]:
+    """Load a segmented fused carry saved by :func:`save_fused_carry`,
+    validating the solver family and schema version (a mismatch names
+    the problem instead of resuming a wrong trajectory)."""
+    state = load_pytree(path, mesh=mesh, backend=backend)
+    kind = state.pop("__fused__", None)
+    if kind is None:
+        raise ValueError(
+            f"{path!r} is not a fused-carry checkpoint (it may be a "
+            "class-API save_solver snapshot — load it with load_solver)")
+    if kind != solver:
+        raise ValueError(f"fused-carry checkpoint is for {kind!r}, "
+                         f"not {solver!r}")
+    schema = state.pop("__fused_schema__", None)
+    if schema != FUSED_SCHEMA_VERSION:
+        raise ValueError(
+            f"fused-carry schema {schema!r} != {FUSED_SCHEMA_VERSION} "
+            f"(checkpoint written by an incompatible version)")
+    return state

@@ -115,6 +115,28 @@ KNOBS = [
      "native/__init__.py", "threads for native pack/IO helpers"),
     ("PYLOPS_MPI_TPU_CKPT_BACKEND", "native|orbax", "native",
      "utils/checkpoint.py", "checkpoint encode/decode backend"),
+    ("PYLOPS_MPI_TPU_GUARDS", "off|on", "off",
+     "resilience/status.py (solvers/basic.py, solvers/sparsity.py)",
+     "in-loop breakdown/stagnation guards in the fused solvers; off "
+     "traces bit-identical programs"),
+    ("PYLOPS_MPI_TPU_GUARD_STALL", "int>=2", "50",
+     "resilience/status.py",
+     "stagnation window: iterations without a new best residual "
+     "before status=stagnation"),
+    ("PYLOPS_MPI_TPU_RESTARTS", "int>=0", "2",
+     "resilience/driver.py",
+     "max precision-escalation restarts of resilient_solve"),
+    ("PYLOPS_MPI_TPU_SEGMENT", "int>=0", "0 (one segment)",
+     "solvers/segmented.py",
+     "default epoch length of the segmented fused solvers "
+     "(checkpoint cadence)"),
+    ("PYLOPS_MPI_TPU_RETRIES", "int>=0", "3",
+     "resilience/retry.py (parallel/mesh.py, benchmarks)",
+     "bounded retries for transient host-side faults (multihost "
+     "init, harvest stage spawn)"),
+    ("PYLOPS_MPI_TPU_RETRY_BACKOFF", "seconds", "0.5",
+     "resilience/retry.py",
+     "initial retry backoff (doubling, capped at 30 s)"),
     ("PYLOPS_MPI_TPU_TRACE", "off|spans|full", "off",
      "diagnostics/trace.py (linearoperator, collectives, solvers)",
      "structured span tracing; full adds in-loop solver telemetry"),

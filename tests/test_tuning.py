@@ -300,6 +300,42 @@ def test_cache_cross_process_roundtrip(tmp_path, monkeypatch):
     assert entry and entry["params"] == {"overlap": "on"}
 
 
+def test_cache_two_concurrent_writers_lose_nothing(tmp_path):
+    """ISSUE 6 satellite: two PROCESSES hammering ``store()`` on the
+    same cache file concurrently (the offline CLI racing a live
+    auto-tuning session). The flock-serialized read-merge-write plus
+    pid-suffixed temp staging must keep the file valid at all times
+    and lose NO entry from either writer."""
+    path = tmp_path / "race.json"
+    n = 20
+    code = (
+        "import os, sys\n"
+        "os.environ['PYLOPS_MPI_TPU_TUNE_CACHE'] = %r\n"
+        "from pylops_mpi_tpu.tuning import cache\n"
+        "tag = sys.argv[1]\n"
+        "for i in range(%d):\n"
+        "    cache.store(f'{tag}:{i}', {'params': {'i': i},"
+        " 'provenance': tag})\n" % (str(path), n))
+    env = dict(os.environ, PYLOPS_MPI_TPU_PLATFORM="cpu",
+               JAX_PLATFORMS="cpu")
+    procs = [subprocess.Popen([sys.executable, "-c", code, tag],
+                              env=env, cwd=ROOT,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE)
+             for tag in ("alpha", "beta")]
+    for p in procs:
+        _, err = p.communicate(timeout=240)
+        assert p.returncode == 0, err[-2000:]
+    plans = tcache.load_plans(str(path))
+    expected = {f"{tag}:{i}" for tag in ("alpha", "beta")
+                for i in range(n)}
+    assert expected.issubset(plans), sorted(expected - set(plans))
+    # staging temp files are cleaned up; only the cache + lock remain
+    leftovers = [f for f in os.listdir(tmp_path)
+                 if f.startswith(".tune_cache_")]
+    assert leftovers == []
+
+
 # ----------------------------------------------------- search machinery
 def _fake_factory(times):
     """Factory whose candidates 'run' for a scripted duration."""
