@@ -74,7 +74,19 @@ PAGES = {
         ("Escalation driver", "pylops_mpi_tpu.resilience",
          ["resilient_solve", "ResilientResult"]),
         ("Bounded retry", "pylops_mpi_tpu.resilience.retry",
-         ["retry_call", "default_retries", "default_backoff_s"]),
+         ["retry_call", "default_retries", "default_backoff_s",
+          "default_jitter"]),
+        ("Heartbeats and collective watchdogs",
+         "pylops_mpi_tpu.resilience.elastic",
+         ["elastic_initialize", "worker_config", "WorkerConfig",
+          "maybe_start_heartbeat", "start_heartbeat", "stop_heartbeat",
+          "HeartbeatWriter", "read_heartbeat", "heartbeat_interval",
+          "watched_call", "WatchdogTimeout", "watchdog_mode",
+          "watchdog_enabled", "watchdog_timeout"]),
+        ("Job supervisor (launch, classify, shrink, relaunch)",
+         "pylops_mpi_tpu.resilience.supervisor",
+         ["launch_job", "JobResult", "Failure", "WorkerHandle",
+          "free_port"]),
         ("Fault injection (chaos seams)",
          "pylops_mpi_tpu.resilience.faults",
          ["arm", "disarm", "armed", "consume", "fault_signature",

@@ -67,6 +67,15 @@ STAGE_BUDGETS: Dict[str, Dict[str, Optional[int]]] = {
     # the pre-headline selfcheck subprocess and the per-component cap
     "bench_selfcheck": {"tpu": 600, "rehearse": 600},
     "component":       {"tpu": 150, "rehearse": 150},
+    # elastic-runtime watched phases (resilience/elastic.py
+    # watched_call deadlines; PYLOPS_MPI_TPU_WATCHDOG_TIMEOUT
+    # overrides globally, PROBE_<STAGE>_TIMEOUT per stage):
+    # blocking jax.distributed bring-up, blocking multi-host
+    # checkpoint save/load, and the CI chaos leg's whole
+    # kill/recover suite
+    "multihost_init":  {"tpu": 300, "rehearse": 120},
+    "checkpoint_io":   {"tpu": 600, "rehearse": 300},
+    "multihost_chaos": {"tpu": 900, "rehearse": 600},
 }
 
 _ENV_NAMES = {

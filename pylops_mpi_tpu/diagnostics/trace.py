@@ -38,6 +38,17 @@ JSON object per line when dumped (``dump(path)``); set
 ``PYLOPS_MPI_TPU_TRACE_FILE`` to auto-dump at process exit. Open in
 Perfetto via ``dump(path, fmt="chrome")`` (a single JSON array) or
 ``jq -s . trace.jsonl > trace.json``.
+
+Post-mortem flush: when ``PYLOPS_MPI_TPU_TRACE_FILE`` is set, the
+flush is registered for ``atexit`` AND ``SIGTERM`` (a supervised
+worker's usual death is a signal, which skips atexit entirely), and it
+is installed at the FIRST span *entry*, not just the first completed
+event — a worker killed inside its very first span still leaves a
+parseable artifact. Spans still open at flush time are emitted as
+Chrome ``ph="B"`` (begin-without-end) events, so the post-mortem shows
+exactly which phase the process died in. The SIGTERM handler chains
+any previously-installed handler, then re-raises the default so the
+exit status still says "killed by SIGTERM".
 """
 
 from __future__ import annotations
@@ -50,7 +61,8 @@ from collections import deque
 from typing import Dict, List, Optional
 
 __all__ = ["trace_mode", "trace_enabled", "span", "op_span", "event",
-           "counter", "get_events", "clear_events", "dump", "span_tree"]
+           "counter", "get_events", "clear_events", "dump", "span_tree",
+           "open_span_events"]
 
 _MODES = ("off", "spans", "full")
 _warned_mode = False
@@ -97,6 +109,10 @@ _BUF: deque = deque(maxlen=_buffer_size())
 _EPOCH_NS = time.perf_counter_ns()
 _tls = threading.local()  # per-thread open-span stack (nesting depth)
 _atexit_registered = False
+# Cross-thread registry of OPEN spans (id(span) → span): the flush
+# handlers read it to emit ph="B" events for phases cut short by a
+# kill. Distinct from _tls.stack, which only the owning thread sees.
+_OPEN: Dict[int, "_Span"] = {}
 
 
 def _now_us() -> float:
@@ -136,15 +152,40 @@ def _jax_tracing() -> bool:
         return False
 
 
-def _record(ev: Dict) -> None:
+def _ensure_flush_handlers() -> None:
+    """Register the exit-flush (atexit + SIGTERM) once, iff
+    ``PYLOPS_MPI_TPU_TRACE_FILE`` is set. Called from both span entry
+    and event recording, so a process killed inside its FIRST span
+    (nothing completed yet) still flushes. Caller holds ``_LOCK``."""
     global _atexit_registered
+    if _atexit_registered or not os.environ.get(
+            "PYLOPS_MPI_TPU_TRACE_FILE"):
+        return
+    import atexit
+    atexit.register(_atexit_dump)
+    try:  # signal handlers only install from the main thread
+        import signal
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_sigterm(signum, frame):
+            _atexit_dump()
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(signum, frame)
+            else:  # die with the honest "killed by SIGTERM" status
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):
+        pass  # non-main thread / exotic platform: atexit still covers us
+    _atexit_registered = True
+
+
+def _record(ev: Dict) -> None:
     with _LOCK:
         _BUF.append(ev)
-        if not _atexit_registered and os.environ.get(
-                "PYLOPS_MPI_TPU_TRACE_FILE"):
-            import atexit
-            atexit.register(_atexit_dump)
-            _atexit_registered = True
+        _ensure_flush_handlers()
 
 
 def _atexit_dump() -> None:
@@ -180,7 +221,7 @@ class _Span:
     exit, carrying its nesting depth and parent name so span trees can
     be rebuilt from the flat buffer (``span_tree``)."""
 
-    __slots__ = ("name", "args", "t0", "_depth", "_parent")
+    __slots__ = ("name", "args", "t0", "_depth", "_parent", "_tid")
 
     def __init__(self, name: str, args: Dict):
         self.name = name
@@ -188,6 +229,7 @@ class _Span:
         self.t0 = 0.0
         self._depth = 0
         self._parent = None
+        self._tid = 0
 
     def tag(self, **tags) -> "_Span":
         """Attach tags discovered mid-span (e.g. a resolved chunk
@@ -203,6 +245,10 @@ class _Span:
         self._parent = stack[-1].name if stack else None
         stack.append(self)
         self.t0 = _now_us()
+        self._tid = threading.get_ident()
+        with _LOCK:
+            _OPEN[id(self)] = self
+            _ensure_flush_handlers()  # flush even if we never close
         return self
 
     def __exit__(self, *exc):
@@ -210,6 +256,8 @@ class _Span:
         stack = getattr(_tls, "stack", ())
         if stack and stack[-1] is self:
             stack.pop()
+        with _LOCK:
+            _OPEN.pop(id(self), None)
         args = dict(self.args)
         args["depth"] = self._depth
         if self._parent is not None:
@@ -290,16 +338,42 @@ def get_events() -> List[Dict]:
 
 
 def clear_events() -> None:
+    """Drop buffered events AND forget open-span registrations (a test
+    that leaked a span must not haunt later dumps; a leaked span's own
+    ``__exit__`` pops nothing and stays harmless)."""
     with _LOCK:
         _BUF.clear()
+        _OPEN.clear()
+
+
+def open_span_events() -> List[Dict]:
+    """Chrome ``ph="B"`` events for every span currently OPEN, across
+    all threads — the post-mortem's "died while doing X" lines. Safe
+    from signal/atexit context (one lock, no allocation surprises)."""
+    with _LOCK:
+        spans = list(_OPEN.values())
+    out = []
+    for s in spans:
+        args = dict(s.args)
+        args["open"] = True
+        args["depth"] = s._depth
+        if s._parent is not None:
+            args["parent"] = s._parent
+        out.append({"name": s.name, "ph": "B", "ts": round(s.t0, 3),
+                    "pid": os.getpid(), "tid": s._tid,
+                    "cat": args.pop("cat", "span"), "args": args})
+    out.sort(key=lambda ev: ev["ts"])
+    return out
 
 
 def dump(path: str, fmt: str = "jsonl") -> int:
     """Write the buffered events to ``path``: ``fmt="jsonl"`` (one
     Chrome event object per line — the artifact format) or
     ``fmt="chrome"`` (a single JSON array Perfetto/chrome://tracing
-    open directly). Returns the number of events written."""
-    events = get_events()
+    open directly). Spans still open at dump time are appended as
+    ``ph="B"`` (begin) events so a killed process's in-flight phase
+    survives to the artifact. Returns the number of events written."""
+    events = get_events() + open_span_events()
     if fmt == "chrome":
         with open(path, "w") as f:
             json.dump(events, f)

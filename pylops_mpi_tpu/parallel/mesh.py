@@ -115,15 +115,28 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
     :func:`pylops_mpi_tpu.resilience.retry.retry_call`
     (``PYLOPS_MPI_TPU_RETRIES`` / ``PYLOPS_MPI_TPU_RETRY_BACKOFF``;
     per-call ``retries=``/``backoff_s=`` override). The final failure
-    propagates unchanged."""
+    propagates unchanged.
+
+    It is also the canonical place to block FOREVER: ``initialize``
+    waits for every peer, so one dead host hangs the rest past any
+    retry. Under supervision (or ``PYLOPS_MPI_TPU_WATCHDOG=on``) the
+    whole retried bring-up therefore runs under the collective
+    watchdog (stage ``multihost_init`` of the central
+    ``STAGE_BUDGETS`` table) and raises
+    :class:`~pylops_mpi_tpu.resilience.elastic.WatchdogTimeout` at the
+    deadline — the worker exits, the supervisor reclassifies and
+    relaunches on the surviving hosts. Unsupervised processes see a
+    plain direct call, bit-identical to before."""
     import jax.distributed
+    from ..resilience.elastic import watched_call
     from ..resilience.retry import retry_call
-    retry_call(jax.distributed.initialize,
-               coordinator_address=coordinator_address,
-               num_processes=num_processes,
-               process_id=process_id,
-               retries=retries, backoff_s=backoff_s,
-               describe="jax.distributed.initialize")
+    watched_call(retry_call, jax.distributed.initialize,
+                 coordinator_address=coordinator_address,
+                 num_processes=num_processes,
+                 process_id=process_id,
+                 retries=retries, backoff_s=backoff_s,
+                 describe="jax.distributed.initialize",
+                 stage="multihost_init")
 
 
 def make_mesh_hybrid(ici_axis: str = SP_AXIS, dcn_axis: str = "dcn",

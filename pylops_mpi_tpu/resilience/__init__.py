@@ -1,6 +1,6 @@
-"""Resilient solver runtime (ISSUE 6).
+"""Resilient solver + elastic job runtime (ISSUEs 6 and 8).
 
-Four pieces, layered over the fused solvers:
+In-process (layered over the fused solvers):
 
 - :mod:`.status` — the in-loop status word
   (``converged``/``maxiter``/``breakdown``/``stagnation``) and the
@@ -8,25 +8,50 @@ Four pieces, layered over the fused solvers:
   programs).
 - :mod:`.driver` — :func:`resilient_solve`: precision-escalation
   restarts from the last finite iterate (bf16 → f32 → f64).
-- :mod:`.retry` — bounded retry/backoff for transient host-side
-  faults (multihost init, harvest stage spawn).
+- :mod:`.retry` — bounded retry/backoff (with decorrelating jitter)
+  for transient host-side faults (multihost init, harvest stage
+  spawn).
 - :mod:`.faults` — the chaos seams that prove all of the above end to
   end (in-loop NaN/stall injection, plan-cache corruption, flaky
   callables).
 
+Across processes (the elastic multi-host runtime):
+
+- :mod:`.elastic` — the worker side: heartbeat writer thread, the
+  supervisor↔worker env contract, and the collective watchdog
+  (:func:`watched_call`) that turns a hung peer into a classified
+  :class:`WatchdogTimeout`.
+- :mod:`.supervisor` — :func:`launch_job`: launch N workers, watch
+  heartbeats, classify failures (exit / signal / stale heartbeat),
+  kill stragglers and relaunch on the surviving slots with a shrunk
+  world; mesh-elastic checkpoint restore
+  (:func:`pylops_mpi_tpu.utils.checkpoint.load_fused_carry` with a
+  new ``mesh``) carries the state across.
+
 Segmented checkpoint/resume lives with the solvers
 (:mod:`pylops_mpi_tpu.solvers.segmented`) and the carry schema in
-:mod:`pylops_mpi_tpu.utils.checkpoint`. See ``docs/robustness.md``.
+:mod:`pylops_mpi_tpu.utils.checkpoint`. See ``docs/robustness.md``
+and ``docs/multihost.md#surviving-failures``.
 """
 
-from . import faults, retry, status
+from . import elastic, faults, retry, status, supervisor
 from .status import (RUNNING, CONVERGED, MAXITER, BREAKDOWN, STAGNATION,
                      status_name, guards_mode, guards_enabled,
                      last_status)
 from .retry import retry_call
 from .driver import resilient_solve, ResilientResult
+from .elastic import (WatchdogTimeout, watched_call, watchdog_mode,
+                      watchdog_enabled, start_heartbeat, stop_heartbeat,
+                      maybe_start_heartbeat, worker_config,
+                      elastic_initialize, WorkerConfig)
+from .supervisor import launch_job, JobResult, Failure, WorkerHandle
 
-__all__ = ["faults", "retry", "status", "RUNNING", "CONVERGED",
-           "MAXITER", "BREAKDOWN", "STAGNATION", "status_name",
-           "guards_mode", "guards_enabled", "last_status", "retry_call",
-           "resilient_solve", "ResilientResult"]
+__all__ = ["elastic", "faults", "retry", "status", "supervisor",
+           "RUNNING", "CONVERGED", "MAXITER", "BREAKDOWN", "STAGNATION",
+           "status_name", "guards_mode", "guards_enabled", "last_status",
+           "retry_call", "resilient_solve", "ResilientResult",
+           "WatchdogTimeout", "watched_call", "watchdog_mode",
+           "watchdog_enabled", "start_heartbeat", "stop_heartbeat",
+           "maybe_start_heartbeat", "worker_config",
+           "elastic_initialize", "WorkerConfig",
+           "launch_job", "JobResult", "Failure", "WorkerHandle"]

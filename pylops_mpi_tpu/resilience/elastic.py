@@ -1,0 +1,344 @@
+"""Worker-side elastic runtime: heartbeats and the collective watchdog.
+
+The supervisor (:mod:`.supervisor`) can only act on what it can
+observe from outside the worker process. This module is the worker's
+half of that contract:
+
+- **Heartbeats** — a daemon thread writes a small JSON beat file every
+  ``PYLOPS_MPI_TPU_HEARTBEAT`` seconds (atomically: temp + replace, so
+  the supervisor never reads a torn beat). The thread is independent
+  of the main thread, so a worker stuck inside a fused epoch or a long
+  compile still beats; the beat STOPS only when the process is truly
+  wedged (SIGSTOP, runaway GC, kernel-level stall) or dead — exactly
+  the states the supervisor classifies as ``stale_heartbeat``.
+- **The collective watchdog** — blocking host-side phases that wait on
+  *peers* (``jax.distributed`` bring-up, multi-host checkpoint
+  save/load) hang forever when one peer is gone; a heartbeat cannot
+  catch this, because the *stuck* worker's beat thread keeps running.
+  :func:`watched_call` runs such a phase in a worker thread with a
+  deadline from the central :data:`~pylops_mpi_tpu.diagnostics.\
+profiler.STAGE_BUDGETS` table (the same machinery the harvest ladder's
+  :class:`~pylops_mpi_tpu.diagnostics.profiler.DeadlineRunner` uses)
+  and raises a classified :class:`WatchdogTimeout` instead of blocking
+  — the worker exits nonzero, the supervisor reaps it and relaunches
+  the job on the surviving host set.
+
+Gating: the watchdog defaults to ``auto`` — armed only when the
+process is SUPERVISED (``PYLOPS_MPI_TPU_HEARTBEAT_FILE`` is set by the
+supervisor), so plain library use is bit-for-bit unchanged (no extra
+threads, no trace events; the off-mode pins in
+``tests/test_supervisor.py`` hold this). ``PYLOPS_MPI_TPU_WATCHDOG=on``
+arms it unconditionally; ``off`` disarms even under supervision.
+
+The env contract (set by :func:`.supervisor.launch_job`, read by
+:func:`worker_config` / :func:`elastic_initialize`):
+
+==================================  ====================================
+``PYLOPS_MPI_TPU_COORDINATOR``      ``host:port`` of the jax.distributed
+                                    coordinator for THIS attempt
+``PYLOPS_MPI_TPU_NUM_PROCESSES``    world size of this attempt (shrinks
+                                    after a failure)
+``PYLOPS_MPI_TPU_PROCESS_ID``       this worker's rank in the attempt
+``PYLOPS_MPI_TPU_ATTEMPT``          0-based relaunch counter
+``PYLOPS_MPI_TPU_HEARTBEAT_FILE``   where to write beats
+``PYLOPS_MPI_TPU_HEARTBEAT``        beat interval, seconds
+==================================  ====================================
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from collections import namedtuple
+from typing import Any, Callable, Dict, Optional
+
+from ..diagnostics import trace as _trace
+from ..diagnostics.profiler import STAGE_BUDGETS
+
+__all__ = ["heartbeat_interval", "heartbeat_file", "HeartbeatWriter",
+           "start_heartbeat", "stop_heartbeat", "maybe_start_heartbeat",
+           "read_heartbeat", "WatchdogTimeout", "watchdog_mode",
+           "watchdog_enabled", "watchdog_timeout", "watched_call",
+           "WorkerConfig", "worker_config", "elastic_initialize"]
+
+
+# ------------------------------------------------------------ heartbeats
+def heartbeat_interval() -> float:
+    """``PYLOPS_MPI_TPU_HEARTBEAT`` beat interval in seconds (default
+    1.0; floored at 0.05 so a typo cannot busy-spin the writer)."""
+    try:
+        v = float(os.environ.get("PYLOPS_MPI_TPU_HEARTBEAT", "1.0"))
+    except ValueError:
+        v = 1.0
+    return max(0.05, v)
+
+
+def heartbeat_file() -> Optional[str]:
+    """``PYLOPS_MPI_TPU_HEARTBEAT_FILE`` — the beat path the supervisor
+    assigned this worker, or ``None`` when unsupervised."""
+    return os.environ.get("PYLOPS_MPI_TPU_HEARTBEAT_FILE") or None
+
+
+class HeartbeatWriter(threading.Thread):
+    """Daemon thread writing ``{"pid", "seq", "wall", "mono"}`` to
+    ``path`` every ``interval`` seconds, atomically (pid-suffixed temp
+    + ``os.replace``), so the supervisor's reader can never observe a
+    torn beat. ``stop()`` is idempotent and joins the thread."""
+
+    def __init__(self, path: str, interval: float):
+        super().__init__(name="pylops-heartbeat", daemon=True)
+        self.path = os.path.abspath(path)
+        self.interval = float(interval)
+        self.seq = 0
+        # NOT named _stop: Thread.join() calls a private self._stop()
+        self._halt = threading.Event()
+
+    def beat(self) -> None:
+        self.seq += 1
+        payload = json.dumps({"pid": os.getpid(), "seq": self.seq,
+                              "wall": time.time(),
+                              "mono": time.monotonic()})
+        tmp = self.path + f".tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # a full disk must not kill the worker via its beat
+
+    def run(self) -> None:
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self.beat()  # first beat immediately: bring-up counts as alive
+        while not self._halt.wait(self.interval):
+            self.beat()
+
+    def stop(self) -> None:
+        self._halt.set()
+        if self.is_alive():
+            self.join(timeout=5.0)
+
+
+_HB_LOCK = threading.Lock()
+_WRITER: Optional[HeartbeatWriter] = None
+
+
+def start_heartbeat(path: Optional[str] = None,
+                    interval: Optional[float] = None
+                    ) -> Optional[HeartbeatWriter]:
+    """Start (or return the already-running) heartbeat writer. With no
+    ``path`` argument the env contract decides; returns ``None`` when
+    no path is configured — the unsupervised no-op."""
+    global _WRITER
+    path = path or heartbeat_file()
+    if path is None:
+        return None
+    with _HB_LOCK:
+        if _WRITER is not None and _WRITER.is_alive():
+            return _WRITER
+        _WRITER = HeartbeatWriter(
+            path, heartbeat_interval() if interval is None else interval)
+        _WRITER.start()
+        return _WRITER
+
+
+def maybe_start_heartbeat() -> Optional[HeartbeatWriter]:
+    """Env-driven auto-start used by long-running entry points (the
+    segmented solvers): one dict lookup when unsupervised, the running
+    writer when supervised. Safe to call from anywhere, any number of
+    times."""
+    if heartbeat_file() is None:
+        return None
+    return start_heartbeat()
+
+
+def stop_heartbeat() -> None:
+    global _WRITER
+    with _HB_LOCK:
+        if _WRITER is not None:
+            _WRITER.stop()
+            _WRITER = None
+
+
+def read_heartbeat(path: str) -> Optional[Dict[str, Any]]:
+    """Supervisor-side beat reader: the parsed beat dict, or ``None``
+    when the file is missing or (transiently) unparseable."""
+    try:
+        with open(path) as f:
+            return json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+
+
+# ------------------------------------------------------------- watchdog
+class WatchdogTimeout(RuntimeError):
+    """A watched host-side phase blew its deadline — a hung peer, not
+    a slow computation. Carries ``stage`` and ``timeout_s`` so the
+    supervisor's failure record (and the trace event) name the phase
+    that wedged."""
+
+    def __init__(self, stage: str, timeout_s: float):
+        self.stage = stage
+        self.timeout_s = float(timeout_s)
+        super().__init__(
+            f"watchdog: stage {stage!r} still blocked after "
+            f"{timeout_s:.0f}s — a peer is likely hung or gone; "
+            "exiting so the supervisor can relaunch on the surviving "
+            "hosts (docs/robustness.md#collective-watchdog)")
+
+
+_WD_MODES = ("auto", "on", "off")
+_warned_wd = False
+
+
+def watchdog_mode() -> str:
+    """``PYLOPS_MPI_TPU_WATCHDOG`` resolved to ``auto``/``on``/``off``
+    (default ``auto``; unknown values warn once and fall back to
+    ``auto`` — same rule as the overlap/trace knobs)."""
+    global _warned_wd
+    m = os.environ.get("PYLOPS_MPI_TPU_WATCHDOG", "auto").strip().lower()
+    if m in ("", "none", "default"):
+        m = "auto"
+    if m not in _WD_MODES:
+        if not _warned_wd:
+            import warnings
+            warnings.warn(
+                f"PYLOPS_MPI_TPU_WATCHDOG={m!r} is not one of "
+                f"{_WD_MODES}; using 'auto'", stacklevel=2)
+            _warned_wd = True
+        m = "auto"
+    return m
+
+
+def watchdog_enabled() -> bool:
+    """``on`` → armed; ``off`` → disarmed; ``auto`` (default) → armed
+    only when this process is supervised (a heartbeat file is
+    configured) — plain library use never grows watchdog threads."""
+    m = watchdog_mode()
+    if m == "on":
+        return True
+    if m == "off":
+        return False
+    return heartbeat_file() is not None
+
+
+def watchdog_timeout(stage: str, default: Optional[float] = None) -> float:
+    """Deadline for one watched stage: the global override
+    ``PYLOPS_MPI_TPU_WATCHDOG_TIMEOUT`` when set, else the stage's row
+    in the central ``STAGE_BUDGETS`` table (``tpu`` column), else
+    ``default`` (300 s)."""
+    raw = os.environ.get("PYLOPS_MPI_TPU_WATCHDOG_TIMEOUT")
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    row = STAGE_BUDGETS.get(stage)
+    if row and row.get("tpu"):
+        return float(row["tpu"])
+    return 300.0 if default is None else float(default)
+
+
+_wd_tls = threading.local()  # reentrancy: nested watched phases run direct
+
+
+def watched_call(fn: Callable, *args, stage: str,
+                 timeout_s: Optional[float] = None, **kwargs):
+    """Run ``fn(*args, **kwargs)`` under the collective watchdog.
+
+    Disarmed (the default, unsupervised case) this is a direct call —
+    zero threads, zero trace events, bit-identical behavior. Armed, the
+    call runs in a daemon worker thread with deadline
+    ``timeout_s`` (default: :func:`watchdog_timeout` for ``stage``);
+    if the deadline passes, a ``resilience.watchdog`` trace event is
+    emitted and :class:`WatchdogTimeout` is raised in the CALLER —
+    the blocked thread is left behind (Python cannot kill it), which
+    is exactly right for a supervised worker: the raise unwinds to a
+    nonzero exit and the supervisor reaps the whole process. Nested
+    watched phases (checkpoint-inside-harvest) run direct under the
+    outer deadline instead of stacking threads."""
+    if not watchdog_enabled() or getattr(_wd_tls, "active", False):
+        return fn(*args, **kwargs)
+    deadline = watchdog_timeout(stage) if timeout_s is None \
+        else float(timeout_s)
+    out: "queue.Queue" = queue.Queue(maxsize=1)
+
+    def runner():
+        _wd_tls.active = True
+        try:
+            out.put((True, fn(*args, **kwargs)))
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            out.put((False, e))
+
+    t = threading.Thread(target=runner, daemon=True,
+                         name=f"pylops-watchdog-{stage}")
+    with _trace.span("resilience.watchdog", cat="resilience",
+                     stage=stage, timeout_s=deadline):
+        t.start()
+        try:
+            ok, payload = out.get(timeout=deadline)
+        except queue.Empty:
+            _trace.event("resilience.watchdog_timeout", cat="resilience",
+                         stage=stage, timeout_s=deadline)
+            raise WatchdogTimeout(stage, deadline) from None
+    if ok:
+        return payload
+    raise payload
+
+
+# ----------------------------------------------------- worker bring-up
+WorkerConfig = namedtuple(
+    "WorkerConfig", ["coordinator", "num_processes", "process_id",
+                     "attempt", "heartbeat_path", "heartbeat_s"])
+WorkerConfig.__doc__ = (
+    "The supervisor-assigned identity of this worker process for the "
+    "CURRENT attempt: coordinator address, (possibly shrunk) world "
+    "size, rank, 0-based relaunch counter, and the heartbeat "
+    "assignment. Unsupervised processes get "
+    "(None, None, None, 0, None, interval).")
+
+
+def _env_int(name: str) -> Optional[int]:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def worker_config() -> WorkerConfig:
+    """Read the supervisor env contract (module docstring)."""
+    return WorkerConfig(
+        coordinator=os.environ.get("PYLOPS_MPI_TPU_COORDINATOR") or None,
+        num_processes=_env_int("PYLOPS_MPI_TPU_NUM_PROCESSES"),
+        process_id=_env_int("PYLOPS_MPI_TPU_PROCESS_ID"),
+        attempt=_env_int("PYLOPS_MPI_TPU_ATTEMPT") or 0,
+        heartbeat_path=heartbeat_file(),
+        heartbeat_s=heartbeat_interval())
+
+
+def elastic_initialize() -> WorkerConfig:
+    """One-call worker bring-up for supervised jobs: start the
+    heartbeat, then — when this attempt's world has more than one
+    process — join the ``jax.distributed`` job named by the env
+    contract (under the bounded retry AND the collective watchdog via
+    :func:`~pylops_mpi_tpu.parallel.mesh.initialize_multihost`).
+    Single-process attempts (the shrunk mesh after every peer failed)
+    skip the distributed runtime entirely and run on local devices.
+    Returns the :class:`WorkerConfig` so the worker can build its
+    (possibly shrunk) mesh from ``num_processes``."""
+    cfg = worker_config()
+    maybe_start_heartbeat()
+    if cfg.num_processes is not None and cfg.num_processes > 1:
+        from ..parallel.mesh import initialize_multihost
+        initialize_multihost(coordinator_address=cfg.coordinator,
+                             num_processes=cfg.num_processes,
+                             process_id=cfg.process_id)
+    _trace.event("resilience.elastic_init", cat="resilience",
+                 attempt=cfg.attempt, world=cfg.num_processes or 1,
+                 rank=cfg.process_id or 0)
+    return cfg

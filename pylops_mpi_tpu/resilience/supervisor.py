@@ -1,0 +1,361 @@
+"""Elastic job supervisor: launch, watch, classify, shrink, relaunch.
+
+The multi-host story so far launches workers fire-and-forget
+(``tests/test_multihost.py`` did its own ``subprocess.Popen`` pair) and
+a single hung or preempted worker turns the whole job into a silent
+wall-clock burn. :func:`launch_job` generalizes that launcher into the
+missing control loop:
+
+1. **Launch** N workers with the elastic env contract
+   (:mod:`.elastic` module docstring): coordinator address on a fresh
+   free port, world size, rank, attempt counter, and a per-worker
+   heartbeat file assignment.
+2. **Watch** — poll worker processes and their heartbeat files.
+3. **Classify** every failure into one of three kinds (the table in
+   ``docs/robustness.md#failure-classification``):
+
+   ===================  =============================================
+   ``exit``             process ended with a nonzero return code
+   ``signal``           process was killed by a signal (rc < 0)
+   ``stale_heartbeat``  process alive but its beat file has not been
+                        touched for ``stale_factor`` × the beat
+                        interval — wedged (SIGSTOP'd, deadlocked in a
+                        collective, runaway swap), not dead
+   ===================  =============================================
+
+4. **Shrink + relaunch** — kill every straggler of the failed attempt
+   (a job that lost one peer deadlocks the rest inside their next
+   collective), then relaunch on the SURVIVING worker slots with a
+   shrunk world size and a fresh coordinator port, up to
+   ``max_relaunches`` times. Workers see the new world via the env
+   contract and rebuild their (smaller) mesh; mesh-elastic checkpoint
+   restore (``utils/checkpoint.py``) makes the saved state land on it.
+
+The supervisor deliberately imports neither jax nor the worker's code:
+it supervises OS processes and files only, so it stays responsive while
+workers compile, collect, or die. Worker stdout/stderr go to per-worker
+log files (PIPEs would deadlock a chatty worker on a full pipe buffer);
+the tail of each log is collected into the result for post-mortems.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..diagnostics import trace as _trace
+from .elastic import read_heartbeat
+
+__all__ = ["WorkerHandle", "Failure", "JobResult", "free_port",
+           "launch_job"]
+
+
+def free_port() -> int:
+    """An OS-assigned free TCP port for the attempt's coordinator.
+    (Small race window between close and the coordinator's bind — the
+    bounded retry inside ``initialize_multihost`` absorbs a loss.)"""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class WorkerHandle:
+    """One launched worker process of one attempt."""
+    rank: int                 # rank within the CURRENT attempt's world
+    slot: int                 # stable identity across attempts
+    proc: subprocess.Popen
+    heartbeat_path: str
+    log_path: str
+    launched_at: float        # monotonic; bring-up grace reference
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+@dataclass
+class Failure:
+    """One classified worker failure (see module docstring table)."""
+    attempt: int
+    rank: int
+    slot: int
+    kind: str                 # "exit" | "signal" | "stale_heartbeat"
+    returncode: Optional[int]
+    detail: str
+    detected_after_s: float   # since this attempt's launch
+
+    def as_dict(self) -> Dict:
+        return {"attempt": self.attempt, "rank": self.rank,
+                "slot": self.slot, "kind": self.kind,
+                "returncode": self.returncode, "detail": self.detail,
+                "detected_after_s": round(self.detected_after_s, 3)}
+
+
+@dataclass
+class JobResult:
+    """What :func:`launch_job` hands back: whether the final attempt
+    finished clean, how many processes that attempt ran with, every
+    classified failure along the way, and the tail of each final
+    worker's log (keyed by rank)."""
+    ok: bool
+    world_size: int
+    attempts: int
+    failures: List[Failure] = field(default_factory=list)
+    outputs: Dict[int, str] = field(default_factory=dict)
+    returncodes: Dict[int, int] = field(default_factory=dict)
+    logdir: Optional[str] = None
+
+
+def _format_argv(argv: Sequence[str], *, port: int, rank: int,
+                 world: int, attempt: int) -> List[str]:
+    """Expand the ``{port}``/``{rank}``/``{world}``/``{attempt}``
+    placeholders. Non-placeholder args pass through untouched (a
+    literal ``{`` elsewhere is the caller's problem to escape, but no
+    existing worker argv carries one)."""
+    subst = {"port": port, "rank": rank, "world": world,
+             "attempt": attempt}
+    out = []
+    for a in argv:
+        try:
+            out.append(str(a).format(**subst))
+        except (KeyError, IndexError, ValueError):
+            out.append(str(a))
+    return out
+
+
+def _tail(path: str, max_bytes: int = 8192) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - max_bytes))
+            return f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return ""
+
+
+def _kill_all(workers: Sequence[WorkerHandle]) -> None:
+    """SIGKILL the whole attempt. A worker that lost a peer is (or soon
+    will be) blocked inside a collective; there is nothing graceful to
+    wait for, and SIGCONT-before-KILL would only matter for SIGSTOP'd
+    workers, which SIGKILL reaps regardless."""
+    for w in workers:
+        if w.alive():
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+    for w in workers:
+        try:
+            w.proc.wait(timeout=10.0)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def _classify(w: WorkerHandle, *, stale_s: float,
+              now_mono: float) -> Optional[Dict]:
+    """Return ``{"kind", "returncode", "detail"}`` when worker ``w`` has
+    failed, else None. Heartbeat staleness is judged against the beat
+    file's mtime (wall clock — mtimes are epoch-stamped), with the
+    LAUNCH time (monotonic) standing in as beat zero so a worker that
+    dies before its first beat is caught by the same rule."""
+    rc = w.proc.poll()
+    if rc is not None:
+        if rc == 0:
+            return None  # clean exit is success, handled by the caller
+        if rc < 0:
+            try:
+                signame = signal.Signals(-rc).name
+            except ValueError:
+                signame = f"signal {-rc}"
+            return {"kind": "signal", "returncode": rc,
+                    "detail": f"killed by {signame}"}
+        return {"kind": "exit", "returncode": rc,
+                "detail": f"exited with code {rc}"}
+    try:
+        beat_age = time.time() - os.path.getmtime(w.heartbeat_path)
+    except OSError:
+        beat_age = now_mono - w.launched_at  # no beat: age since launch
+    if beat_age > stale_s:
+        beat = read_heartbeat(w.heartbeat_path)
+        return {"kind": "stale_heartbeat", "returncode": None,
+                "detail": (f"no heartbeat for {beat_age:.2f}s "
+                           f"(threshold {stale_s:.2f}s; last beat "
+                           f"{beat})")}
+    return None
+
+
+def launch_job(argv: Sequence[str], num_workers: int, *,
+               max_relaunches: int = 1,
+               shrink: bool = True,
+               heartbeat_interval: float = 1.0,
+               stale_factor: float = 2.0,
+               grace_s: Optional[float] = None,
+               poll_s: float = 0.05,
+               job_timeout_s: Optional[float] = None,
+               env: Optional[Dict[str, str]] = None,
+               logdir: Optional[str] = None,
+               on_poll: Optional[Callable[[int, List[WorkerHandle]],
+                                          None]] = None,
+               python: Optional[str] = None) -> JobResult:
+    """Launch ``num_workers`` supervised worker processes and babysit
+    them to completion, relaunching on a shrunk world after failures.
+
+    ``argv`` is the worker command line; ``{port}``, ``{rank}``,
+    ``{world}`` and ``{attempt}`` placeholders are expanded per worker
+    per attempt (so ``tests/multihost_worker.py``'s positional
+    ``<port> <rank>`` convention slots straight in), and the same
+    values always travel in the env contract for workers that prefer
+    :func:`~pylops_mpi_tpu.resilience.elastic.worker_config`. When
+    ``argv[0]`` ends in ``.py`` it is run under ``python`` (default:
+    ``sys.executable``).
+
+    Failure handling: the FIRST classified failure of an attempt kills
+    the whole attempt (peers are wedging in collectives already) and —
+    while relaunch budget remains — relaunches on the surviving slots:
+    ``shrink=True`` (default) drops the failed worker's slot so the new
+    attempt runs with a smaller world; ``shrink=False`` keeps the world
+    size (a supervisor for jobs whose hosts come back, e.g. spot
+    reclaims with replacement). A relaunch budget of ``max_relaunches``
+    bounds the loop; a shrink below one worker, or a timeout
+    (``job_timeout_s``, whole job), ends it with ``ok=False``.
+
+    Staleness: a worker counts as wedged when its beat file mtime is
+    older than ``stale_factor × heartbeat_interval`` (plus ``grace_s``
+    of bring-up slack, default ``10 × interval``, applied only until
+    the first beat lands — interpreter start + jax import dwarf the
+    beat interval).
+
+    ``on_poll(attempt, workers)`` runs every poll tick — the chaos
+    tests use it to SIGSTOP a worker mid-epoch; production callers can
+    use it for progress reporting.
+
+    Worker env: inherits ``os.environ``, overlaid with ``env``, overlaid
+    with the elastic contract (contract wins — a stale
+    ``PYLOPS_MPI_TPU_PROCESS_ID`` from an outer supervised run must not
+    leak into workers)."""
+    if num_workers < 1:
+        raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+    argv = [str(a) for a in argv]
+    python = python or sys.executable
+    logdir = logdir or tempfile.mkdtemp(prefix="pylops-supervisor-")
+    os.makedirs(logdir, exist_ok=True)
+    if grace_s is None:
+        grace_s = 10.0 * heartbeat_interval
+    stale_s = stale_factor * heartbeat_interval
+
+    result = JobResult(ok=False, world_size=num_workers, attempts=0,
+                       logdir=logdir)
+    slots = list(range(num_workers))  # surviving stable identities
+    t_job = time.monotonic()
+
+    for attempt in range(max_relaunches + 1):
+        world = len(slots)
+        port = free_port()
+        result.attempts = attempt + 1
+        result.world_size = world
+        _trace.event("supervisor.launch", cat="resilience",
+                     attempt=attempt, world=world, port=port,
+                     slots=list(slots))
+        workers: List[WorkerHandle] = []
+        for rank, slot in enumerate(slots):
+            hb = os.path.join(logdir,
+                              f"worker{slot}.attempt{attempt}.hb")
+            log = os.path.join(logdir,
+                               f"worker{slot}.attempt{attempt}.log")
+            wenv = dict(os.environ)
+            if env:
+                wenv.update(env)
+            wenv.update({
+                "PYLOPS_MPI_TPU_COORDINATOR": f"127.0.0.1:{port}",
+                "PYLOPS_MPI_TPU_NUM_PROCESSES": str(world),
+                "PYLOPS_MPI_TPU_PROCESS_ID": str(rank),
+                "PYLOPS_MPI_TPU_ATTEMPT": str(attempt),
+                "PYLOPS_MPI_TPU_HEARTBEAT_FILE": hb,
+                "PYLOPS_MPI_TPU_HEARTBEAT": repr(heartbeat_interval),
+            })
+            # relaunched peers must not re-dial the coordinator in
+            # lockstep; setdefault so an explicit caller value wins
+            wenv.setdefault("PYLOPS_MPI_TPU_RETRY_JITTER", "0.25")
+            cmd = _format_argv(argv, port=port, rank=rank, world=world,
+                               attempt=attempt)
+            if cmd and cmd[0].endswith(".py"):
+                cmd = [python] + cmd
+            logf = open(log, "wb")
+            try:
+                proc = subprocess.Popen(cmd, stdout=logf,
+                                        stderr=subprocess.STDOUT,
+                                        env=wenv)
+            finally:
+                logf.close()  # the child holds its own fd now
+            workers.append(WorkerHandle(rank=rank, slot=slot, proc=proc,
+                                        heartbeat_path=hb, log_path=log,
+                                        launched_at=time.monotonic()))
+
+        failure: Optional[Failure] = None
+        while True:
+            now = time.monotonic()
+            if job_timeout_s is not None and now - t_job > job_timeout_s:
+                _kill_all(workers)
+                failure = Failure(
+                    attempt=attempt, rank=-1, slot=-1, kind="timeout",
+                    returncode=None,
+                    detail=f"job exceeded {job_timeout_s}s",
+                    detected_after_s=now - workers[0].launched_at)
+                result.failures.append(failure)
+                result.outputs = {w.rank: _tail(w.log_path)
+                                  for w in workers}
+                _trace.event("supervisor.timeout", cat="resilience",
+                             attempt=attempt)
+                return result  # a job timeout is terminal, no relaunch
+            if on_poll is not None:
+                on_poll(attempt, workers)
+            for w in workers:
+                # bring-up grace: until the first beat file appears,
+                # only the longer grace window applies
+                eff_stale = stale_s if os.path.exists(w.heartbeat_path) \
+                    else max(stale_s, grace_s)
+                cls = _classify(w, stale_s=eff_stale, now_mono=now)
+                if cls is not None:
+                    failure = Failure(attempt=attempt, rank=w.rank,
+                                      slot=w.slot,
+                                      detected_after_s=now - w.launched_at,
+                                      **cls)
+                    break
+            if failure is not None:
+                break
+            if all(w.proc.poll() == 0 for w in workers):
+                result.ok = True
+                result.outputs = {w.rank: _tail(w.log_path)
+                                  for w in workers}
+                result.returncodes = {w.rank: 0 for w in workers}
+                _trace.event("supervisor.success", cat="resilience",
+                             attempt=attempt, world=world)
+                return result
+            time.sleep(poll_s)
+
+        # ---- attempt failed: kill stragglers, record, shrink, retry
+        result.failures.append(failure)
+        _trace.event("supervisor.failure", cat="resilience",
+                     **failure.as_dict())
+        _kill_all(workers)
+        result.outputs = {w.rank: _tail(w.log_path) for w in workers}
+        result.returncodes = {w.rank: (w.proc.poll()
+                                       if w.proc.poll() is not None
+                                       else -9)
+                              for w in workers}
+        if shrink and failure.slot in slots:
+            slots = [s for s in slots if s != failure.slot]
+        if not slots or attempt >= max_relaunches:
+            return result
+        _trace.event("supervisor.relaunch", cat="resilience",
+                     attempt=attempt + 1, world=len(slots),
+                     slots=list(slots))
+    return result

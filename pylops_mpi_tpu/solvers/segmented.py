@@ -231,7 +231,11 @@ _CGLS_FIELDS = ("x", "s", "c", "q", "kold", "iiter", "cost", "cost1",
 def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
                checkpoint_path, resume, backend, guards, on_epoch):
     from ..resilience import status as _rstatus
+    from ..resilience.elastic import maybe_start_heartbeat
     from ..utils import checkpoint as _ckpt
+    # under a supervisor (heartbeat file assigned in the env) the long
+    # epoch loop is exactly what must prove liveness; no-op otherwise
+    maybe_start_heartbeat()
     is_cgls = solver == "cgls"
     fields = _CGLS_FIELDS if is_cgls else _CG_FIELDS
     guards_on, stall_n = _guard_params(guards)

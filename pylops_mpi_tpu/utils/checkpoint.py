@@ -14,18 +14,41 @@ it as a genuine improvement with two backends:
   is the multi-host requirement (``asarray()`` cannot fetch
   non-addressable shards on a pod; see docs/multihost.md) — with the
   partition metadata in a JSON sidecar inside the directory.
+
+**Mesh-elastic restore** (ISSUE 8): loading with a ``mesh`` whose
+device count differs from the save-time shard count RESHARDS instead
+of failing — the balanced :func:`~pylops_mpi_tpu.parallel.partition.\
+local_split` recomputes the per-shard layout for the new device count
+(the same host-side regrid family as
+:func:`~pylops_mpi_tpu.parallel.collectives.all_to_all_resharding`
+performs on device), so a checkpoint written by an 8-device
+``dcn(2)×ici(4)`` job restores onto the 4-device mesh that survives a
+host loss. Exact-count loads keep the saved ``local_shapes``
+bit-for-bit, so same-mesh resume is unchanged. Only the genuinely
+impossible regrids refuse, with the reason named:
+a ``mask`` (sub-communicator colors are a statement about the OLD
+topology — no canonical meaning on a different device count), or a
+SCATTER axis shorter than the new device count (some devices would own
+zero rows — re-pick the mesh or the axis). See
+``docs/robustness.md#mesh-elastic-restore``.
+
+Both backends write crash-atomically (build-beside + rename); a worker
+killed mid-save can leave at most a stale temp, which the next save in
+the same path garbage-collects.
 """
 
 from __future__ import annotations
 
 import os
 import pickle
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 import jax
 
+from ..diagnostics import trace as _trace
 from ..distributedarray import DistributedArray, Partition
+from ..parallel.partition import unpad_index_map
 from ..stacked import StackedDistributedArray
 
 __all__ = ["save_solver", "load_solver", "save_pytree", "load_pytree",
@@ -68,11 +91,54 @@ def _encode(v):
     return v
 
 
+def _target_n_shards(mesh) -> int:
+    if mesh is None:
+        from ..parallel.mesh import default_mesh
+        mesh = default_mesh()
+    return int(mesh.devices.size)
+
+
+def _check_elastic(partition: Partition, axis: int,
+                   global_shape: Tuple[int, ...], mask, n_old: int,
+                   n_new: int) -> None:
+    """Refuse the genuinely impossible regrids, naming the reason.
+    Everything else reshards via the balanced split."""
+    if mask is not None:
+        raise ValueError(
+            f"cannot restore a masked DistributedArray onto a "
+            f"{n_new}-device mesh: its mask (sub-communicator colors "
+            f"{tuple(mask)!r}) describes the original {n_old}-device "
+            "topology and has no canonical regrid — rebuild the array "
+            "and its mask for the new mesh, or restore onto a mesh "
+            "with the original device count")
+    if partition == Partition.SCATTER and global_shape[axis] < n_new:
+        raise ValueError(
+            f"cannot reshard a SCATTER axis of length "
+            f"{global_shape[axis]} onto {n_new} devices: some devices "
+            "would own zero rows. Restore onto a mesh with at most "
+            f"{global_shape[axis]} devices, or shard a longer axis")
+
+
 def _decode(v, mesh=None):
     if isinstance(v, dict) and v.get("__dist__"):
+        partition = Partition[v["partition"]]
+        axis = v["axis"]
+        local_shapes, mask = v["local_shapes"], v["mask"]
+        n_old, n_new = len(local_shapes), _target_n_shards(mesh)
+        if n_old != n_new:
+            # mesh-elastic restore: the saved "value" is the LOGICAL
+            # global array, so resharding is just a fresh balanced
+            # split over the new device count
+            _check_elastic(partition, axis, np.shape(v["value"]), mask,
+                           n_old, n_new)
+            _trace.event("checkpoint.elastic_reshard", cat="checkpoint",
+                         backend="native", partition=partition.name,
+                         axis=axis, n_old=n_old, n_new=n_new,
+                         global_shape=list(np.shape(v["value"])))
+            local_shapes = None  # balanced local_split on the new mesh
         out = DistributedArray.to_dist(
-            v["value"], mesh=mesh, partition=Partition[v["partition"]],
-            axis=v["axis"], local_shapes=v["local_shapes"], mask=v["mask"])
+            v["value"], mesh=mesh, partition=partition,
+            axis=axis, local_shapes=local_shapes, mask=mask)
         return out
     if isinstance(v, dict) and v.get("__stacked__"):
         return StackedDistributedArray([_decode(d, mesh) for d in v["arrays"]])
@@ -173,23 +239,43 @@ def _save_orbax(path: str, tree: Dict[str, Any]) -> None:
     path = os.path.abspath(path)
     # crash safety mirrors the native backend: build the complete new
     # checkpoint beside the old one, then swap directories — a crash at
-    # any point leaves either the old or the new checkpoint whole
-    tmp = path + ".tmp" + secrets.token_hex(4)
+    # any point leaves either the old or the new checkpoint whole.
+    # Multi-process: a save is a RENDEZVOUS — every process streams its
+    # addressable shards into ONE deterministic temp dir (orbax
+    # coordinates the per-shard writes), and only process 0 writes the
+    # sidecar and performs the swap, fenced by barriers so no process
+    # returns before the new checkpoint is visible.
+    nproc = jax.process_count()
+    if nproc > 1:
+        tmp = path + ".tmp-multiproc"
+        if jax.process_index() == 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+        _barrier("pylops_ckpt_pre")
+    else:
+        tmp = path + ".tmp" + secrets.token_hex(4)
     if arrays:
         import orbax.checkpoint as ocp
         with ocp.PyTreeCheckpointer() as ckptr:
             ckptr.save(tmp, arrays, force=True)
-    else:  # scalar/string-only tree: meta-only checkpoint directory
-        os.makedirs(tmp, exist_ok=True)
-    with open(os.path.join(tmp, "pylops_meta.json"), "w") as f:
-        json.dump(meta, f)
-    old = None
-    if os.path.exists(path):
-        old = path + ".old" + secrets.token_hex(4)
-        os.rename(path, old)
-    os.rename(tmp, path)
-    if old is not None:
-        shutil.rmtree(old, ignore_errors=True)
+    elif nproc <= 1 or jax.process_index() == 0:
+        os.makedirs(tmp, exist_ok=True)  # scalar-only: meta-only dir
+    if nproc <= 1 or jax.process_index() == 0:
+        with open(os.path.join(tmp, "pylops_meta.json"), "w") as f:
+            json.dump(meta, f)
+        old = None
+        if os.path.exists(path):
+            old = path + ".old" + secrets.token_hex(4)
+            os.rename(path, old)
+        os.rename(tmp, path)
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+    if nproc > 1:
+        _barrier("pylops_ckpt_post")
+
+
+def _barrier(tag: str) -> None:
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(tag)
 
 
 def _load_orbax(path: str, mesh=None) -> Dict[str, Any]:
@@ -199,19 +285,54 @@ def _load_orbax(path: str, mesh=None) -> Dict[str, Any]:
     with open(os.path.join(path, "pylops_meta.json")) as f:
         meta = json.load(f)
     arrays = {}
-    if any(m.get("kind") in ("dist", "array") for m in meta.values()):
+    array_keys = [k for k, m in meta.items()
+                  if m.get("kind") in ("dist", "array")]
+    if array_keys:
         import orbax.checkpoint as ocp
+        # restore every leaf as a host numpy array: a checkpoint
+        # written by a MULTI-process job carries jax.Array shard
+        # metadata orbax cannot re-materialize without a concrete
+        # sharding — and the elastic-restore path re-places the data
+        # on the (possibly different) target mesh itself anyway
+        rargs = {k: ocp.RestoreArgs(restore_type=np.ndarray)
+                 for k in array_keys}
         with ocp.PyTreeCheckpointer() as ckptr:
-            arrays = ckptr.restore(path)
+            arrays = ckptr.restore(path, restore_args=rargs)
     mesh = mesh if mesh is not None else default_mesh()
     out: Dict[str, Any] = {}
 
     def _dist(k, m):
+        partition = Partition[m["partition"]]
+        axis = int(m["axis"])
+        global_shape = tuple(m["global_shape"])
+        saved_shapes = [tuple(s) for s in m["local_shapes"]]
+        mask = tuple(m["mask"]) if m["mask"] is not None else None
+        n_old, n_new = len(saved_shapes), int(mesh.devices.size)
+        if n_old != n_new:
+            # mesh-elastic restore. Orbax stores the PHYSICAL
+            # pad-to-max buffer, so first gather it back to the
+            # logical global array (unpad via the old shard sizes),
+            # then re-split balanced over the new device count.
+            _check_elastic(partition, axis, global_shape, mask,
+                           n_old, n_new)
+            _trace.event("checkpoint.elastic_reshard", cat="checkpoint",
+                         backend="orbax", partition=partition.name,
+                         axis=axis, n_old=n_old, n_new=n_new,
+                         global_shape=list(global_shape))
+            phys = np.asarray(arrays[k])
+            if partition == Partition.SCATTER:
+                sizes = [s[axis] for s in saved_shapes]
+                logical = np.take(phys, unpad_index_map(sizes),
+                                  axis=axis)
+            else:  # broadcast: the physical buffer IS the global array
+                logical = phys
+            return DistributedArray.to_dist(
+                logical, mesh=mesh, partition=partition, axis=axis,
+                local_shapes=None, mask=None)
         d = DistributedArray(
-            global_shape=tuple(m["global_shape"]), mesh=mesh,
-            partition=Partition[m["partition"]], axis=m["axis"],
-            local_shapes=[tuple(s) for s in m["local_shapes"]],
-            mask=tuple(m["mask"]) if m["mask"] is not None else None,
+            global_shape=global_shape, mesh=mesh,
+            partition=partition, axis=axis,
+            local_shapes=saved_shapes, mask=mask,
             dtype=arrays[k].dtype)
         d._arr = d._place(jax.numpy.asarray(arrays[k]))
         return d
@@ -239,16 +360,30 @@ def _load_orbax(path: str, mesh=None) -> Dict[str, Any]:
     return out
 
 
-def save_pytree(path: str, tree: Dict[str, Any],
-                backend: Optional[str] = None) -> None:
-    """Serialize a dict of arrays/DistributedArrays/scalars.
+def _gc_stale_tmps(path: str) -> None:
+    """Drop temp files left by a worker KILLED mid-save (pid-suffixed,
+    and the pid no longer runs). The kill-mid-save tests prove the
+    previous checkpoint loads regardless; this just stops dead temps
+    accumulating across supervisor relaunches in the same directory."""
+    import glob
+    import re
+    for tmp in glob.glob(path + ".tmp*"):
+        m = re.match(re.escape(path) + r"\.tmp(\d+)$", tmp)
+        if not m or int(m.group(1)) == os.getpid():
+            continue
+        try:
+            os.kill(int(m.group(1)), 0)  # raises when the pid is gone
+        except ProcessLookupError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        except OSError:
+            pass  # pid exists but isn't ours to probe: leave its temp
 
-    ``backend="native"`` (default): large array payloads stream
-    one-by-one (flat peak memory) into a uniquely-named sidecar via the
-    native threaded writer; the pickle references the sidecar by name
-    and is replaced atomically, so a crash mid-save leaves the previous
-    checkpoint pair intact. ``backend="orbax"``: directory checkpoint
-    with per-shard writes and no host gather (multi-host safe)."""
+
+def _save_pytree_impl(path: str, tree: Dict[str, Any],
+                      backend: Optional[str] = None) -> None:
     backend = backend or os.environ.get("PYLOPS_MPI_TPU_CKPT_BACKEND",
                                         "native")
     if backend == "orbax":
@@ -262,6 +397,7 @@ def save_pytree(path: str, tree: Dict[str, Any],
     blobs: list = []
     enc = _extract_blobs(enc, blobs)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    _gc_stale_tmps(path)
     old_sidecars = glob.glob(os.path.abspath(path) + ".blobs.*")
     blob_name = None
     if blobs:
@@ -276,14 +412,43 @@ def save_pytree(path: str, tree: Dict[str, Any],
     tmp = path + f".tmp{os.getpid()}"
     with open(tmp, "wb") as f:
         pickle.dump(enc, f)
+        # durability before visibility: the rename must never land a
+        # file whose bytes are still in the page cache when the host
+        # dies — fsync the temp, THEN swap it in
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
     for old in old_sidecars:
         if os.path.basename(old) != blob_name and os.path.exists(old):
             os.remove(old)
 
 
-def load_pytree(path: str, mesh=None,
-                backend: Optional[str] = None) -> Dict[str, Any]:
+def save_pytree(path: str, tree: Dict[str, Any],
+                backend: Optional[str] = None) -> None:
+    """Serialize a dict of arrays/DistributedArrays/scalars.
+
+    ``backend="native"`` (default): large array payloads stream
+    one-by-one (flat peak memory) into a uniquely-named sidecar via the
+    native threaded writer; the pickle references the sidecar by name,
+    is fsynced, and is replaced atomically, so a crash at ANY point
+    mid-save leaves the previous checkpoint pair intact (stale temps
+    from killed writers are garbage-collected on the next save).
+    ``backend="orbax"``: directory checkpoint with per-shard writes and
+    no host gather (multi-host safe).
+
+    On a multi-host job a save is also a RENDEZVOUS (every process must
+    write its shards), so under supervision it runs under the
+    collective watchdog (stage ``checkpoint_io``) — a save blocked on a
+    dead peer becomes a classified
+    :class:`~pylops_mpi_tpu.resilience.elastic.WatchdogTimeout` instead
+    of an infinite hang. Unsupervised: a plain direct call."""
+    from ..resilience.elastic import watched_call
+    return watched_call(_save_pytree_impl, path, tree, backend=backend,
+                        stage="checkpoint_io")
+
+
+def _load_pytree_impl(path: str, mesh=None,
+                      backend: Optional[str] = None) -> Dict[str, Any]:
     backend = backend or os.environ.get("PYLOPS_MPI_TPU_CKPT_BACKEND",
                                         "native")
     if backend not in ("native", "orbax"):
@@ -306,6 +471,17 @@ def load_pytree(path: str, mesh=None,
         blob_buf = native.read_binary(blob_path, np.uint8, (nbytes,))
         enc = _restore_blobs(enc, blob_buf)
     return {k: _decode(v, mesh) for k, v in enc.items()}
+
+
+def load_pytree(path: str, mesh=None,
+                backend: Optional[str] = None) -> Dict[str, Any]:
+    """Load a :func:`save_pytree` checkpoint. Pass ``mesh`` to restore
+    onto a specific mesh — including one with a DIFFERENT device count
+    (mesh-elastic restore, module docstring). Watchdogged like
+    :func:`save_pytree` (a multi-host load is a rendezvous too)."""
+    from ..resilience.elastic import watched_call
+    return watched_call(_load_pytree_impl, path, mesh=mesh,
+                        backend=backend, stage="checkpoint_io")
 
 
 def save_solver(path: str, solver, x=None,
@@ -369,7 +545,14 @@ def load_fused_carry(path: str, solver: str, mesh=None,
                      backend: Optional[str] = None) -> Dict[str, Any]:
     """Load a segmented fused carry saved by :func:`save_fused_carry`,
     validating the solver family and schema version (a mismatch names
-    the problem instead of resuming a wrong trajectory)."""
+    the problem instead of resuming a wrong trajectory).
+
+    ``mesh`` may differ from the save-time mesh in device count and
+    axis split (mesh-elastic restore, module docstring): the carry's
+    distributed vectors reshard onto the new balanced split, so a
+    shrunk post-failure job resumes the solve where the full job
+    left off. Recurrence scalars are layout-independent and pass
+    through untouched."""
     state = load_pytree(path, mesh=mesh, backend=backend)
     kind = state.pop("__fused__", None)
     if kind is None:

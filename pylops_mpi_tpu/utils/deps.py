@@ -165,6 +165,36 @@ KNOBS = [
     ("PYLOPS_MPI_TPU_TEST_DEVICES", "int", "8",
      "tests/conftest.py, .github/workflows/build.yml",
      "virtual-device count of the CPU-sim test mesh"),
+    ("PYLOPS_MPI_TPU_RETRY_JITTER", "float in [0,1]", "0",
+     "resilience/retry.py",
+     "decorrelating backoff jitter fraction (supervisor sets 0.25 for "
+     "workers so reconnects don't stampede)"),
+    ("PYLOPS_MPI_TPU_HEARTBEAT", "seconds", "1.0",
+     "resilience/elastic.py",
+     "heartbeat-write interval of supervised workers"),
+    ("PYLOPS_MPI_TPU_HEARTBEAT_FILE", "path", "unset (unsupervised)",
+     "resilience/elastic.py (set by resilience/supervisor.py)",
+     "per-worker beat file; also the auto trigger for the collective "
+     "watchdog"),
+    ("PYLOPS_MPI_TPU_WATCHDOG", "auto|on|off", "auto",
+     "resilience/elastic.py (parallel/mesh.py, utils/checkpoint.py)",
+     "collective watchdog over blocking host-side phases; auto arms "
+     "only under supervision, off is bit-identical"),
+    ("PYLOPS_MPI_TPU_WATCHDOG_TIMEOUT", "seconds",
+     "STAGE_BUDGETS per stage", "resilience/elastic.py",
+     "global override of every watched stage's deadline"),
+    ("PYLOPS_MPI_TPU_COORDINATOR", "host:port", "set by supervisor",
+     "resilience/elastic.py, resilience/supervisor.py",
+     "jax.distributed coordinator address of the current attempt"),
+    ("PYLOPS_MPI_TPU_NUM_PROCESSES", "int>=1", "set by supervisor",
+     "resilience/elastic.py, resilience/supervisor.py",
+     "world size of the current attempt (shrinks after failures)"),
+    ("PYLOPS_MPI_TPU_PROCESS_ID", "int>=0", "set by supervisor",
+     "resilience/elastic.py, resilience/supervisor.py",
+     "this worker's rank within the current attempt"),
+    ("PYLOPS_MPI_TPU_ATTEMPT", "int>=0", "set by supervisor",
+     "resilience/elastic.py, resilience/supervisor.py",
+     "0-based relaunch counter of the supervised job"),
 ]
 
 

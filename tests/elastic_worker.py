@@ -1,0 +1,111 @@
+"""Worker for the supervised elastic chaos test (ISSUE 8 acceptance).
+
+Launched by ``resilience.launch_job`` (see
+``tests/test_supervisor.py::test_chaos_kill_recover_resume``), reading
+its identity from the elastic env contract
+(``pylops_mpi_tpu.resilience.elastic.worker_config``):
+
+- **world > 1** (the initial attempt): two processes with 4 virtual
+  CPU devices each join over gloo, build the dcn(2)×ici(4) hybrid mesh
+  and run a SEGMENTED f64 CGLS solve, checkpointing the fused carry
+  every epoch through the orbax backend (the multi-host one). A small
+  ``on_epoch`` sleep keeps the solve long enough for the supervisor to
+  SIGSTOP one worker mid-solve.
+- **world == 1** (the shrunk attempt after the supervisor reaped the
+  wedged peer): the surviving slot reruns THE SAME code on its local
+  4-device mesh; ``resume=True`` picks up the epoch checkpoint, whose
+  8-shard carry is elastically resharded onto the 4-device mesh, and
+  the solve runs to completion. The final iterate is written to
+  ``$PYLOPS_ELASTIC_OUT`` for the test to compare against the
+  uninterrupted trajectory.
+
+Same seed → identical data in every process and attempt, so the
+resumed trajectory is the uninterrupted one (f64, within regrid
+reduction-order noise ≪ 1e-6).
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4"
+                           ).strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+# gloo collectives only when this attempt actually spans processes: a
+# single-process (shrunk) attempt never calls jax.distributed.initialize
+# and the gloo CPU client refuses to build without a distributed client
+if int(os.environ.get("PYLOPS_MPI_TPU_NUM_PROCESSES", "1")) > 1:
+    try:  # cross-process CPU collectives (name varies across versions)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import time  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+
+def build_problem(pmt, mesh):
+    """Seed-0 block-diagonal LS problem, identical in every process."""
+    from pylops_mpi_tpu.ops.local import MatrixMult
+    rng = np.random.default_rng(0)
+    n, nb = 24, 8
+    blocks = []
+    for _ in range(nb):
+        b = rng.standard_normal((n, n)) / np.sqrt(n)
+        np.fill_diagonal(b, b.diagonal() + 4.0)
+        blocks.append(b)
+    xt = rng.standard_normal(nb * n)
+    y = np.concatenate([b @ xt[i * n:(i + 1) * n]
+                        for i, b in enumerate(blocks)])
+    Op = pmt.MPIBlockDiag([MatrixMult(b, dtype=np.float64)
+                           for b in blocks], mesh=mesh)
+    dy = pmt.DistributedArray.to_dist(y, mesh=mesh)
+    x0 = pmt.DistributedArray.to_dist(np.zeros_like(xt), mesh=mesh)
+    return Op, dy, x0, xt
+
+
+def main() -> None:
+    from pylops_mpi_tpu.resilience.elastic import elastic_initialize
+    cfg = elastic_initialize()  # heartbeat + (world>1) gloo bring-up
+    import pylops_mpi_tpu as pmt
+
+    world = cfg.num_processes or 1
+    if world > 1:
+        assert jax.process_count() == world, jax.process_count()
+        mesh = pmt.make_mesh_hybrid(dcn_size=world)
+        assert mesh.devices.shape == (world, 4), mesh.devices.shape
+    else:
+        mesh = pmt.make_mesh()  # the shrunk local 4-device mesh
+    pmt.set_default_mesh(mesh)
+
+    Op, dy, x0, xt = build_problem(pmt, mesh)
+    ckpt = os.environ["PYLOPS_ELASTIC_CKPT"]
+    epoch_sleep = float(os.environ.get("PYLOPS_ELASTIC_EPOCH_SLEEP",
+                                       "0.25"))
+
+    def on_epoch(info):
+        # stretch the solve so a mid-epoch SIGSTOP lands reliably;
+        # the heartbeat thread keeps beating through the sleep
+        time.sleep(epoch_sleep)
+
+    res = pmt.cgls_segmented(Op, dy, x0=x0, niter=60, tol=0.0, epoch=5,
+                             checkpoint_path=ckpt, resume=True,
+                             backend="orbax", on_epoch=on_epoch)
+    if world == 1:
+        out = os.environ.get("PYLOPS_ELASTIC_OUT")
+        if out:
+            np.save(out, np.asarray(res.x.asarray()))
+    print(f"ELASTIC OK attempt={cfg.attempt} world={world} "
+          f"rank={cfg.process_id or 0} iiter={int(res.iiter)}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

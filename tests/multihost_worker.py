@@ -33,6 +33,11 @@ sys.path.insert(0, ROOT)
 def main() -> None:
     port, pid = int(sys.argv[1]), int(sys.argv[2])
     import pylops_mpi_tpu as pmt
+    # under resilience.launch_job this starts the beat thread before
+    # the gloo rendezvous (the phase a wedged peer hangs); standalone
+    # it is a no-op (no PYLOPS_MPI_TPU_HEARTBEAT_FILE)
+    from pylops_mpi_tpu.resilience.elastic import maybe_start_heartbeat
+    maybe_start_heartbeat()
     pmt.initialize_multihost(coordinator_address=f"localhost:{port}",
                              num_processes=2, process_id=pid)
     assert jax.process_count() == 2, jax.process_count()

@@ -296,11 +296,15 @@ def test_rehearse_never_overwrites_tpu_cache(tmp_path, monkeypatch):
     # every stage runner would re-run (rev mismatch) and fail fast off
     # TPU; the point is the tpu-platform entry must survive untouched
     monkeypatch.setenv("PROBE_SELFCHECK_TIMEOUT", "5")
+    monkeypatch.setenv("PROBE_TUNE_TIMEOUT", "5")
     monkeypatch.setenv("PROBE_SMALL_TIMEOUT", "5")
+    monkeypatch.setenv("PROBE_FFT_PLANAR_TIMEOUT", "5")
     monkeypatch.setenv("PROBE_BREAKDOWN_TIMEOUT", "5")
     monkeypatch.setenv("PROBE_DIAG_TIMEOUT", "5")
     monkeypatch.setenv("PROBE_MID_TIMEOUT", "5")
     monkeypatch.setenv("PROBE_FULL_TIMEOUT", "5")
+    monkeypatch.setenv("PROBE_OVERLAP_TIMEOUT", "5")
+    monkeypatch.setenv("PROBE_BISECT_TIMEOUT", "5")
     out = tpl.harvest(dict(cache), rehearse=True)
     assert out["selfcheck"]["result"]["platform"] == "tpu"
     assert out["selfcheck"]["code_rev"] == "old"

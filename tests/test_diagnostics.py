@@ -589,3 +589,94 @@ def test_bench_rows_carry_roofline_columns(rng):
                          "ici_gbps": None}, n_dev=NDEV)
     assert rl["bound"] == "hbm"
     assert rl["predicted_s"] > 0
+
+
+# ----------------------------------------- post-mortem trace flush
+# (ISSUE 8 satellite) trace.py is stdlib-only, so subprocesses load it
+# by file path — jax-free, milliseconds per case — and die in various
+# ways while a span is open; the JSONL artifact must survive.
+
+_TRACE_PY = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "pylops_mpi_tpu", "diagnostics",
+    "trace.py")
+
+_FLUSH_PRELUDE = f"""
+import importlib.util, os, signal, sys, time
+spec = importlib.util.spec_from_file_location("trace_mod", {_TRACE_PY!r})
+trace = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(trace)
+"""
+
+
+def _run_flush_child(body, env_extra, timeout=60):
+    import subprocess
+    import sys
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PYLOPS_MPI_TPU_TRACE")}
+    env.update(env_extra)
+    return subprocess.Popen([sys.executable, "-u", "-c",
+                             _FLUSH_PRELUDE + body],
+                            env=env, stdout=subprocess.PIPE, text=True)
+
+
+def test_trace_flush_on_sigterm(tmp_path):
+    """A worker SIGTERMed mid-span (the supervisor's polite kill)
+    leaves a parseable JSONL with a ph="B" record naming the phase it
+    died in, and still exits with the honest 'killed by SIGTERM'."""
+    import signal
+    out = str(tmp_path / "post.jsonl")
+    body = """
+s = trace.span("solve.epoch", solver="cgls").__enter__()
+trace.event("worker.ready")
+print("READY", flush=True)
+time.sleep(60)
+"""
+    p = _run_flush_child(body, {"PYLOPS_MPI_TPU_TRACE": "spans",
+                                "PYLOPS_MPI_TPU_TRACE_FILE": out})
+    assert p.stdout.readline().strip() == "READY"
+    p.send_signal(signal.SIGTERM)
+    assert p.wait(timeout=60) == -signal.SIGTERM
+    with open(out) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    opens = [e for e in events if e.get("ph") == "B"]
+    assert [e["name"] for e in opens] == ["solve.epoch"]
+    assert opens[0]["args"]["open"] is True
+    assert any(e["name"] == "worker.ready" for e in events)
+
+
+def test_trace_flush_on_atexit_open_span(tmp_path):
+    """A clean interpreter exit with a span still open (sys.exit from
+    inside a phase) flushes via atexit with the open span marked."""
+    out = str(tmp_path / "exit.jsonl")
+    body = """
+with trace.span("outer"):
+    pass
+trace.span("checkpoint.save").__enter__()
+sys.exit(0)
+"""
+    p = _run_flush_child(body, {"PYLOPS_MPI_TPU_TRACE": "spans",
+                                "PYLOPS_MPI_TPU_TRACE_FILE": out})
+    assert p.wait(timeout=60) == 0
+    with open(out) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    assert any(e["name"] == "outer" and e.get("ph") == "X"
+               for e in events)
+    assert any(e["name"] == "checkpoint.save" and e.get("ph") == "B"
+               for e in events)
+
+
+def test_trace_no_handlers_without_trace_file(tmp_path):
+    """Library-quiet pin: without PYLOPS_MPI_TPU_TRACE_FILE, tracing
+    must not install a SIGTERM handler (a host application's signal
+    handling is not ours to take over) and writes no file."""
+    out = str(tmp_path / "none.jsonl")
+    body = """
+with trace.span("work"):
+    pass
+h = signal.getsignal(signal.SIGTERM)
+print("DFL" if h is signal.SIG_DFL else "HOOKED", flush=True)
+"""
+    p = _run_flush_child(body, {"PYLOPS_MPI_TPU_TRACE": "spans"})
+    assert p.stdout.readline().strip() == "DFL"
+    assert p.wait(timeout=60) == 0
+    assert not os.path.exists(out)

@@ -1,0 +1,281 @@
+"""Mesh-elastic checkpoint recovery (ISSUE 8 tentpole) and atomic-save
+crash safety (satellite): carries saved on an 8-device hybrid
+dcn(2)×ici(4) mesh restore onto meshes with DIFFERENT device counts and
+axis splits, resumed solves reproduce the uninterrupted f64 trajectory,
+genuinely impossible regrids refuse with clear errors, and a writer
+killed mid-save never corrupts the previous checkpoint."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import pylops_mpi_tpu as pmt
+from pylops_mpi_tpu import DistributedArray, MPIBlockDiag
+from pylops_mpi_tpu.ops.local import MatrixMult
+from pylops_mpi_tpu.parallel.mesh import (make_mesh, make_mesh_hybrid,
+                                          set_default_mesh)
+from pylops_mpi_tpu.parallel.partition import Partition
+from pylops_mpi_tpu.utils import checkpoint as ckpt
+from pylops_mpi_tpu.utils.checkpoint import (load_pytree, save_pytree)
+
+BACKENDS = ["native", "orbax"]
+
+
+def _backend_or_skip(backend):
+    if backend == "orbax":
+        pytest.importorskip("orbax.checkpoint")
+
+
+@pytest.fixture
+def hybrid_mesh(ndev):
+    """dcn(2)×ici(ndev/2) hybrid mesh — the multi-slice layout a
+    2-process job would build, simulated in-process."""
+    if ndev < 8 or ndev % 2:
+        pytest.skip("hybrid save mesh needs 8 devices")
+    mesh = make_mesh_hybrid(dcn_size=2)
+    assert mesh.devices.shape == (2, ndev // 2)
+    return mesh
+
+
+@pytest.fixture(autouse=True)
+def _restore_default_mesh():
+    yield
+    set_default_mesh(None)
+
+
+# ------------------------------------------------ array-level reshard
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_elastic_restore_fewer_devices(tmp_path, rng, backend,
+                                       hybrid_mesh):
+    """8-shard save → 4-device restore: balanced split, exact data."""
+    _backend_or_skip(backend)
+    v = rng.standard_normal(37)  # ragged on both meshes
+    x = DistributedArray.to_dist(v, mesh=hybrid_mesh)
+    assert len(x.local_shapes) == 8
+    path = str(tmp_path / "x.ckpt")
+    save_pytree(path, {"x": x}, backend=backend)
+
+    small = make_mesh(4)
+    got = load_pytree(path, mesh=small, backend=backend)["x"]
+    assert got.mesh is small and len(got.local_shapes) == 4
+    assert got.local_shapes == ((10,), (9,), (9,), (9,))
+    np.testing.assert_array_equal(np.asarray(got.asarray()), v)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_elastic_restore_axis_split_change(tmp_path, rng, backend,
+                                           hybrid_mesh, ndev):
+    """Same device count, different mesh topology (hybrid (2,4) →
+    flat (8,)): restores with the saved local shapes preserved."""
+    _backend_or_skip(backend)
+    v = rng.standard_normal(41)
+    x = DistributedArray.to_dist(v, mesh=hybrid_mesh)
+    path = str(tmp_path / "x.ckpt")
+    save_pytree(path, {"x": x}, backend=backend)
+
+    flat = make_mesh(ndev)
+    got = load_pytree(path, mesh=flat, backend=backend)["x"]
+    assert got.mesh is flat
+    assert got.local_shapes == x.local_shapes
+    np.testing.assert_array_equal(np.asarray(got.asarray()), v)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_elastic_restore_broadcast(tmp_path, rng, backend, hybrid_mesh):
+    """BROADCAST payloads replicate onto any device count."""
+    _backend_or_skip(backend)
+    v = rng.standard_normal(11)
+    x = DistributedArray.to_dist(v, mesh=hybrid_mesh,
+                                 partition=Partition.BROADCAST)
+    path = str(tmp_path / "b.ckpt")
+    save_pytree(path, {"x": x}, backend=backend)
+    got = load_pytree(path, mesh=make_mesh(4), backend=backend)["x"]
+    assert got.partition is Partition.BROADCAST
+    np.testing.assert_array_equal(np.asarray(got.asarray()), v)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_elastic_refuses_masked(tmp_path, rng, backend, hybrid_mesh):
+    """Sub-communicator masks are topology-bound: restoring one onto a
+    different device count must refuse, not silently remap colors."""
+    _backend_or_skip(backend)
+    x = DistributedArray.to_dist(rng.standard_normal(16),
+                                 mesh=hybrid_mesh,
+                                 mask=[0, 0, 1, 1, 0, 0, 1, 1])
+    path = str(tmp_path / "m.ckpt")
+    save_pytree(path, {"x": x}, backend=backend)
+    with pytest.raises(ValueError, match="mask"):
+        load_pytree(path, mesh=make_mesh(4), backend=backend)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_elastic_refuses_short_axis(tmp_path, backend):
+    """A SCATTER axis shorter than the new device count cannot give
+    every device a shard — a clear error, not a zero-size shard."""
+    _backend_or_skip(backend)
+    small = make_mesh(2)
+    x = DistributedArray.to_dist(np.arange(3.0), mesh=small)
+    path = str(tmp_path / "s.ckpt")
+    save_pytree(path, {"x": x}, backend=backend)
+    with pytest.raises(ValueError, match="zero rows"):
+        load_pytree(path, mesh=make_mesh(4), backend=backend)
+
+
+def test_check_elastic_unit():
+    with pytest.raises(ValueError, match="mask"):
+        ckpt._check_elastic(Partition.SCATTER, 0, (16,), [0, 1], 8, 4)
+    with pytest.raises(ValueError, match="zero rows"):
+        ckpt._check_elastic(Partition.SCATTER, 0, (3,), None, 2, 4)
+    # fine: balanced reshard of a long-enough axis
+    ckpt._check_elastic(Partition.SCATTER, 0, (37,), None, 8, 4)
+    ckpt._check_elastic(Partition.BROADCAST, 0, (3,), None, 2, 4)
+
+
+# ------------------------------------- resumed segmented trajectories
+def _problem(mesh, rng):
+    mats = []
+    for _ in range(8):
+        a = rng.standard_normal((6, 6))
+        mats.append(a @ a.T + 6 * np.eye(6))
+    Op = MPIBlockDiag([MatrixMult(m, dtype=np.float64) for m in mats],
+                      mesh=mesh)
+    y = DistributedArray.to_dist(rng.standard_normal(48), mesh=mesh)
+    x0 = DistributedArray.to_dist(np.zeros(48), mesh=mesh)
+    return Op, y, x0
+
+
+class _Kill(Exception):
+    pass
+
+
+@pytest.mark.parametrize("new_ndev", [4, 8])
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_segmented_resume_on_shrunk_mesh(tmp_path, backend, new_ndev,
+                                         hybrid_mesh, ndev):
+    """The tentpole end-to-end, in-process: segmented CGLS on the
+    hybrid 8-device mesh dies after 3 epochs; the solve resumes on a
+    mesh with ``new_ndev`` devices (4 = elastic shrink, 8 = same count
+    but a flat axis split) and lands on the uninterrupted trajectory."""
+    _backend_or_skip(backend)
+    if new_ndev > ndev:
+        pytest.skip("needs at least new_ndev devices")
+    path = str(tmp_path / "carry.ckpt")
+
+    def rngs():
+        return np.random.default_rng(7)
+
+    Op, y, x0 = _problem(hybrid_mesh, rngs())
+    ref = pmt.cgls_segmented(Op, y, x0=x0, niter=24, tol=0.0, epoch=4)
+    xref = np.asarray(ref.x.asarray())
+
+    def killer(info):
+        if info["epoch"] >= 3:
+            raise _Kill
+
+    with pytest.raises(_Kill):
+        pmt.cgls_segmented(Op, y, x0=x0, niter=24, tol=0.0, epoch=4,
+                           checkpoint_path=path, backend=backend,
+                           on_epoch=killer)
+
+    new_mesh = make_mesh(new_ndev)
+    set_default_mesh(new_mesh)
+    Op2, y2, x02 = _problem(new_mesh, rngs())
+    res = pmt.cgls_segmented(Op2, y2, x0=x02, niter=24, tol=0.0,
+                             epoch=4, checkpoint_path=path,
+                             resume=True, backend=backend)
+    got = np.asarray(res.x.asarray())
+    assert int(res.iiter) == int(ref.iiter)
+    np.testing.assert_allclose(got, xref, rtol=1e-9, atol=1e-12)
+
+
+def test_segmented_resume_plan_mismatch_still_guards(tmp_path,
+                                                     hybrid_mesh, rng):
+    """Elastic restore must not weaken the resume plan check: a carry
+    saved with one ``niter`` refuses to resume under another even on a
+    different mesh."""
+    path = str(tmp_path / "carry.ckpt")
+    Op, y, x0 = _problem(hybrid_mesh, rng)
+
+    def killer(info):
+        raise _Kill
+
+    with pytest.raises(_Kill):
+        pmt.cgls_segmented(Op, y, x0=x0, niter=24, tol=0.0, epoch=4,
+                           checkpoint_path=path, on_epoch=killer)
+    new_mesh = make_mesh(4)
+    set_default_mesh(new_mesh)
+    Op2, y2, x02 = _problem(new_mesh, np.random.default_rng(42))
+    with pytest.raises(ValueError, match="resume must replay"):
+        pmt.cgls_segmented(Op2, y2, x02, niter=30, tol=0.0, epoch=4,
+                           checkpoint_path=path, resume=True)
+
+
+# ------------------------------------------------- kill-mid-save
+def test_kill_mid_save_previous_checkpoint_survives(tmp_path, rng):
+    """ISSUE 8 satellite: a writer killed mid-save leaves only a
+    pid-suffixed temp; the previous checkpoint pair still loads, and a
+    truncated temp is never mistaken for the checkpoint."""
+    v1 = rng.standard_normal(24)
+    x1 = DistributedArray.to_dist(v1)
+    path = str(tmp_path / "c.ckpt")
+    save_pytree(path, {"x": x1, "k": 1})
+
+    # a subprocess starts the NEXT save and is SIGKILLed mid-write via
+    # an os.replace intercept — the real "power cut" moment
+    code = f"""
+import os, sys, signal
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from pylops_mpi_tpu import DistributedArray
+from pylops_mpi_tpu.utils import checkpoint as ckpt
+real_replace = os.replace
+def die(*a, **k):
+    os.kill(os.getpid(), signal.SIGKILL)
+os.replace = die  # the atomic publish is exactly where we get killed
+x = DistributedArray.to_dist(np.arange(24.0))
+ckpt.save_pytree({path!r}, {{"x": x, "k": 2}})
+"""
+    p = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == -9, (p.returncode, p.stderr[-2000:])
+
+    # temps from the dead writer may remain — truncate one harder to
+    # model a partial block-device flush
+    tmps = [f for f in os.listdir(tmp_path)
+            if f.startswith("c.ckpt.tmp")]
+    for t in tmps:
+        with open(tmp_path / t, "r+b") as f:
+            f.truncate(max(os.path.getsize(tmp_path / t) // 2, 1))
+
+    got = load_pytree(path)  # previous pair intact
+    np.testing.assert_array_equal(np.asarray(got["x"].asarray()), v1)
+    assert got["k"] == 1
+
+    # the next save garbage-collects the dead writer's temps and wins
+    x3 = DistributedArray.to_dist(rng.standard_normal(24))
+    save_pytree(path, {"x": x3, "k": 3})
+    assert not [f for f in os.listdir(tmp_path)
+                if f.startswith("c.ckpt.tmp")]
+    assert load_pytree(path)["k"] == 3
+
+
+def test_gc_stale_tmps_keeps_live_pids(tmp_path):
+    path = str(tmp_path / "a.ckpt")
+    reaped = subprocess.Popen([sys.executable, "-c", "pass"])
+    reaped.wait()
+    dead = str(tmp_path / f"a.ckpt.tmp{reaped.pid}")  # pid just died
+    live = str(tmp_path / f"a.ckpt.tmp{os.getpid()}")
+    other = str(tmp_path / "a.ckpt.tmpdir")  # non-pid suffix: not ours
+    for f in (dead, live, other):
+        with open(f, "w") as fh:
+            fh.write("x")
+    ckpt._gc_stale_tmps(path)
+    assert not os.path.exists(dead)
+    assert os.path.exists(live) and os.path.exists(other)

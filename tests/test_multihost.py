@@ -1,11 +1,18 @@
 """Real 2-process ``jax.distributed`` smoke test (round-2 VERDICT
 missing #3): ``initialize_multihost`` + ``make_mesh_hybrid`` were only
-ever exercised as a degenerate single-process mesh. Here pytest spawns
-two worker processes (4 virtual CPU devices each, Gloo collectives, a
-localhost coordinator) that build the dcn(2) x ici(4) mesh and run a
-fused CGLS solve and a SUMMA apply end-to-end — the analog of the
-reference's multi-process CI (ref ``.github/workflows/build.yml``,
+ever exercised as a degenerate single-process mesh. Two worker
+processes (4 virtual CPU devices each, Gloo collectives, a localhost
+coordinator) build the dcn(2) x ici(4) mesh and run fused solves and
+operator applies end-to-end — the analog of the reference's
+multi-process CI (ref ``.github/workflows/build.yml``,
 ``utils/_nccl.py:98-132``).
+
+The pair is launched through :func:`pylops_mpi_tpu.resilience.launch_job`
+(ISSUE 8): the supervisor owns the coordinator port, the per-worker
+logs, and the heartbeat-based hang detection — a wedged gloo rendezvous
+is reaped at the ``multihost_init`` stage budget instead of pytest's
+whole-suite timeout. ``max_relaunches=0`` because a 2-process smoke
+cannot meaningfully shrink (the workers assert the world size).
 
 This also pins the operator-as-pytree-argument contract: multi-process
 JAX rejects jit closures over non-addressable arrays, so the fused
@@ -13,47 +20,37 @@ solvers must pass registered operators as arguments
 (``linearoperator.OP_ARRAY_PYTREES``)."""
 
 import os
-import socket
-import subprocess
-import sys
 
 import pytest
+
+from pylops_mpi_tpu.diagnostics.profiler import stage_budget
+from pylops_mpi_tpu.resilience import launch_job
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(ROOT, "tests", "multihost_worker.py")
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
-
-
 @pytest.mark.slow
 def test_two_process_distributed_solve():
-    port = _free_port()
-    env = dict(os.environ)
     # workers pin jax to 4 virtual CPU devices themselves; scrub any
     # conflicting device-count force inherited from the test process
-    env["XLA_FLAGS"] = " ".join(
-        f for f in env.get("XLA_FLAGS", "").split()
-        if "force_host_platform_device_count" not in f)
-    env.pop("JAX_PLATFORMS", None)
-    procs = [subprocess.Popen([sys.executable, WORKER, str(port), str(i)],
-                              stdout=subprocess.PIPE,
-                              stderr=subprocess.STDOUT, text=True, env=env,
-                              cwd=ROOT)
-             for i in range(2)]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=480)
-            outs.append(out)
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.fail("multihost workers timed out\n"
-                    + "\n---\n".join(outs))
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"worker {i} rc={p.returncode}:\n{out[-3000:]}"
-        assert f"MULTIHOST OK p{i}" in out, out[-3000:]
+    env = {
+        "XLA_FLAGS": " ".join(
+            f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "force_host_platform_device_count" not in f),
+        "JAX_PLATFORMS": "cpu",
+    }
+    r = launch_job([WORKER, "{port}", "{rank}"], 2,
+                   max_relaunches=0,
+                   heartbeat_interval=1.0,
+                   grace_s=stage_budget("multihost_init",
+                                        rehearse=True),
+                   job_timeout_s=stage_budget("multihost_chaos",
+                                              rehearse=True),
+                   env=env)
+    assert r.ok, (r.failures,
+                  {k: v[-3000:] for k, v in r.outputs.items()})
+    assert r.attempts == 1 and r.world_size == 2
+    for rank in (0, 1):
+        assert f"MULTIHOST OK p{rank}" in r.outputs[rank], \
+            r.outputs[rank][-3000:]

@@ -433,6 +433,61 @@ def test_retry_backoff_doubles_and_caps():
     assert slept == [1.0, 2.0, 4.0]
 
 
+def test_retry_jitter_default_off_is_exact(monkeypatch):
+    """ISSUE 8 satellite pin: with the knob unset, backoff stays the
+    exact doubling schedule — jitter is strictly opt-in."""
+    monkeypatch.delenv("PYLOPS_MPI_TPU_RETRY_JITTER", raising=False)
+    assert retry.default_jitter() == 0.0
+    slept = []
+    fn = faults.flaky(lambda: "ok", failures=3)
+    retry.retry_call(fn, retries=3, backoff_s=1.0, sleep=slept.append)
+    assert slept == [1.0, 2.0, 4.0]
+
+
+def test_retry_jitter_decorrelates_within_bounds():
+    """Each jittered sleep lands in [(1-j)·wait, wait] — shrink-only,
+    cap unchanged — and an injected rng makes it deterministic."""
+    import random as _random
+    slept = []
+    fn = faults.flaky(lambda: "ok", failures=3)
+    retry.retry_call(fn, retries=3, backoff_s=1.0, jitter=0.25,
+                     rng=_random.Random(0), sleep=slept.append)
+    base = [1.0, 2.0, 4.0]
+    assert len(slept) == 3 and slept != base
+    for got, want in zip(slept, base):
+        assert 0.75 * want <= got <= want
+    # same seed → same schedule (reproducible chaos runs)
+    again = []
+    fn2 = faults.flaky(lambda: "ok", failures=3)
+    retry.retry_call(fn2, retries=3, backoff_s=1.0, jitter=0.25,
+                     rng=_random.Random(0), sleep=again.append)
+    assert again == slept
+
+
+def test_retry_jitter_env_knob(monkeypatch):
+    monkeypatch.setenv("PYLOPS_MPI_TPU_RETRY_JITTER", "0.5")
+    assert retry.default_jitter() == 0.5
+    monkeypatch.setenv("PYLOPS_MPI_TPU_RETRY_JITTER", "7")
+    assert retry.default_jitter() == 1.0  # clamped
+    monkeypatch.setenv("PYLOPS_MPI_TPU_RETRY_JITTER", "nope")
+    assert retry.default_jitter() == 0.0  # unparseable → off
+
+
+def test_retry_if_vetoes_non_retryable():
+    """The predicate sees the exception; False re-raises unchanged on
+    the FIRST failure — an auth error is not a flaky coordinator."""
+    fn = faults.flaky(lambda: "ok", failures=2)
+    with pytest.raises(TimeoutError, match="injected"):
+        retry.retry_call(fn, retries=5, backoff_s=0.0,
+                         retry_if=lambda e: "transient" in str(e))
+    assert fn.calls == 1  # vetoed immediately, no retry burned
+
+    fn2 = faults.flaky(lambda: "ok", failures=2)
+    out = retry.retry_call(fn2, retries=5, backoff_s=0.0,
+                           retry_if=lambda e: isinstance(e, TimeoutError))
+    assert out == "ok" and fn2.calls == 3
+
+
 def test_initialize_multihost_retries_flaky_coordinator(monkeypatch):
     """The simulated coordinator timeout: jax.distributed.initialize
     fails twice, the bounded retry absorbs it."""
