@@ -157,6 +157,74 @@ def _tune_race_row():
         return {"error": repr(e)[:300]}
 
 
+def _batched_race_row(niter=20):
+    """Batched-throughput race (the batching-PR acceptance bar): one
+    Block-CGLS solve with K RHS columns vs K sequential single-RHS
+    fused solves of the SAME systems, on the flagship block-diagonal
+    family. ``tol=0`` pins both sides to exactly ``niter`` iterations
+    so the race measures schedule amortization, not convergence luck.
+    Stamps ``solves_per_sec@K`` (the serving-throughput headline) and
+    ``batch_plan`` (plan provenance of the operator the block solve
+    ran through). K comes from PYLOPS_MPI_TPU_BATCH when set, else
+    16."""
+    try:
+        import numpy as _np
+        import jax as _jax
+        from pylops_mpi_tpu import DistributedArray, MPIBlockDiag
+        from pylops_mpi_tpu.ops.local import MatrixMult
+        from pylops_mpi_tpu.solvers import block_cgls, cgls
+        from pylops_mpi_tpu.tuning.plan import applied_provenance
+        from pylops_mpi_tpu.utils.deps import batch_default
+        K = batch_default()
+        if K <= 1:
+            K = 16
+        nblk, nblock = 8, 48
+        blocks, _, _ = make_problem(nblk, nblock, seed=3)
+        Op = MPIBlockDiag([MatrixMult(b, dtype=np.float32)
+                           for b in blocks])
+        N = nblk * nblock
+        rng = _np.random.default_rng(7)
+        Y = rng.standard_normal((N, K)).astype(_np.float32)
+        yb = DistributedArray(global_shape=(N, K), dtype=_np.float32)
+        yb[:] = Y
+        ys = []
+        for j in range(K):
+            yj = DistributedArray(global_shape=N, dtype=_np.float32)
+            yj[:] = Y[:, j]
+            ys.append(yj)
+
+        def run_block():
+            out = block_cgls(Op, yb, niter=niter, tol=0.0)
+            _jax.block_until_ready(out[0]._arr)
+            return out
+
+        def run_seq():
+            outs = [cgls(Op, yj, niter=niter, tol=0.0) for yj in ys]
+            _jax.block_until_ready(outs[-1][0]._arr)
+            return outs
+
+        run_block()   # compile both programs outside the timed region
+        run_seq()
+        t0 = time.perf_counter(); bout = run_block()
+        t_blk = time.perf_counter() - t0
+        t0 = time.perf_counter(); souts = run_seq()
+        t_seq = time.perf_counter() - t0
+        # the race only counts if both sides solved the same systems
+        err = max(float(_np.max(_np.abs(
+            _np.asarray(bout[0].array)[:, j]
+            - _np.asarray(souts[j][0].array)))) for j in range(K))
+        return {"K": K, "niter": niter,
+                "shape": [N, N], "nblk": nblk,
+                f"solves_per_sec@{K}": _sig3(K / t_blk),
+                "sequential_solves_per_sec": _sig3(K / t_seq),
+                "speedup_vs_sequential": _sig3(t_seq / t_blk),
+                "block_vs_sequential_max_abs_diff": _sig3(err),
+                "batch_plan": applied_provenance("blockdiag",
+                                                 default="default")}
+    except Exception as e:  # the race must never cost the headline
+        return {"error": repr(e)[:300]}
+
+
 # dense matmul peak per chip, TFLOP/s (bf16 inputs, f32 accumulation on
 # the MXU) — public spec-sheet numbers; most-specific key checked first
 _PEAK_TFLOPS = [
@@ -774,6 +842,15 @@ def child_main():
         _progress("tuner-vs-default race (small shapes)")
         tune_race = _tune_race_row()
 
+    # batched-throughput race (batching PR): block-CGLS with K RHS
+    # columns vs K sequential fused solves; every CPU-sim round,
+    # BENCH_BATCHED_PYLOPS_MPI_TPU=1 forces it on hardware too
+    batched = None
+    batched_env = os.environ.get("BENCH_BATCHED_PYLOPS_MPI_TPU", "")
+    if batched_env != "0" and (not on_tpu or batched_env == "1"):
+        _progress("batched-throughput race (block-CGLS vs sequential)")
+        batched = _batched_race_row()
+
     peak_bf16 = _peak_flops_per_chip(jax.devices()[0], "bf16")
     peak_f32 = _peak_flops_per_chip(jax.devices()[0], "f32_highest")
     peak_hbm = _peak_hbm_gbps(jax.devices()[0]) if on_tpu else None
@@ -921,6 +998,7 @@ def child_main():
         **({"bf16": bf16_res} if bf16_res else {}),
         **({"bf16_race": bf16_race} if bf16_race else {}),
         **({"tune_race": tune_race} if tune_race else {}),
+        **({"batched": batched} if batched else {}),
         **({"selfcheck": selfcheck} if selfcheck is not None else {}),
         **({"cpu_breakdown": cpu_breakdown} if cpu_breakdown else {}),
     }
@@ -1133,7 +1211,7 @@ def _merge_tpu_cache(result, root=None):
                              "degraded", "tpu_error", "components",
                              "cpu_breakdown", "flagship_1dev_cpu",
                              "roofline", "f32", "bf16", "plan",
-                             "tune_race")
+                             "tune_race", "batched")
                             if k in result}
                 result = dict(r)
                 result["cached"] = True
@@ -1146,6 +1224,11 @@ def _merge_tpu_cache(result, root=None):
                 # plan= column stays honest via "default"
                 if cpu_live.get("tune_race") is not None:
                     result["tune_race"] = cpu_live["tune_race"]
+                # same rule for the batched-throughput race: a live
+                # CPU-sim number that must not vanish behind a banked
+                # TPU headline
+                if cpu_live.get("batched") is not None:
+                    result["batched"] = cpu_live["batched"]
                 result.setdefault("plan", "default")
                 # every TPU row carries an HBM qualifier; a legacy
                 # banked artifact predating the hbm_pct schema gets an
@@ -1369,6 +1452,15 @@ def _compact_line(result):
         compact["bf16_race"] = result["bf16_race"]
     if result.get("plan"):
         compact["plan"] = result["plan"]
+    bt = result.get("batched") or {}
+    if bt and not bt.get("error"):
+        compact["batched"] = {
+            k: bt.get(k) for k in
+            ([f"solves_per_sec@{bt.get('K')}", "speedup_vs_sequential",
+              "batch_plan", "K"])
+            if bt.get(k) is not None}
+    elif bt.get("error"):
+        compact["batched"] = {"error": bt["error"][:120]}
     tr = result.get("tune_race") or {}
     if tr and not tr.get("error"):
         compact["tune_race"] = {

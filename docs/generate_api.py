@@ -65,6 +65,10 @@ PAGES = {
          ["cg_guarded", "cgls_guarded", "ista_guarded", "fista_guarded"]),
         ("Segmented (checkpoint/resume)", "pylops_mpi_tpu",
          ["cg_segmented", "cgls_segmented"]),
+        ("Batched (block-Krylov and vmap-over-parameters)",
+         "pylops_mpi_tpu",
+         ["block_cg", "block_cgls", "block_cg_segmented",
+          "batched_solve"]),
         ("Eigenvalues", "pylops_mpi_tpu", ["power_iteration"]),
     ],
     "resilience": [

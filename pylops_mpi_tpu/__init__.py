@@ -37,6 +37,8 @@ from .ops.mdc import MPIMDC
 from .solvers.basic import CG, CGLS, cg, cgls, clear_fused_cache
 from .solvers.sparsity import ISTA, FISTA, ista, fista
 from .solvers.segmented import cg_segmented, cgls_segmented
+from .solvers.block import (block_cg, block_cgls, block_cg_segmented,
+                            batched_solve)
 from .solvers.eigs import power_iteration
 from .resilience import resilient_solve
 from .utils.dottest import dottest

@@ -517,6 +517,31 @@ class DistributedArray:
             return jnp.sum(z)
         return self._reduce(z, "sum")
 
+    def col_dot(self, y: "DistributedArray", vdot: bool = False) -> jax.Array:
+        """Per-column dot product of a block (column-batched) vector:
+        for a ``(N, K)`` array sharded on axis 0 this reduces over the
+        row axis only and returns the ``(K,)`` vector of column dots —
+        the reduction the block-Krylov recurrences need (``dot`` would
+        collapse the column axis too). Padding rows of a ragged split
+        are masked out; accumulation uses the same precision-policy
+        floor as ``dot``."""
+        if self.ndim != 2:
+            raise ValueError(
+                f"col_dot needs a 2-D (rows, columns) array, got "
+                f"global_shape={self._global_shape}")
+        if self._axis != 0:
+            raise ValueError("col_dot needs the row axis sharded (axis=0)")
+        if self._mask is not None:
+            raise NotImplementedError(
+                "col_dot does not support masked (sub-communicator) arrays")
+        a = jnp.conj(self._arr) if vdot else self._arr
+        z = a * self._operand_phys(y)
+        from .ops._precision import accum_dtype
+        z = z.astype(accum_dtype(z.dtype))
+        if self._partition == Partition.SCATTER and not self._even:
+            z = jnp.where(self._valid_phys_mask(), z, 0)
+        return jnp.sum(z, axis=0)
+
     def _vector_norm_flat(self, ord=None) -> jax.Array:
         """Whole-array vector norm, optionally per mask-group
         (ref ``_compute_vector_norm``, ``DistributedArray.py:689-759``)."""

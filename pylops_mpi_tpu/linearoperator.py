@@ -59,31 +59,81 @@ class MPILinearOperator:
     dims: Optional[Tuple[int, ...]] = None
     dimsd: Optional[Tuple[int, ...]] = None
 
+    # Block (column-batched) applies: a ``(N, K)`` DistributedArray is K
+    # independent model vectors sharing one operator apply. Operators
+    # whose ``_matvec``/``_rmatvec`` natively widen their contraction
+    # over the trailing column axis set ``accepts_block = True``;
+    # everything else falls back to a single compiled ``jax.vmap`` over
+    # columns (no per-column Python loop either way).
+    accepts_block = False
+
     # ------------------------------------------------------------- apply
     def matvec(self, x: VectorLike) -> VectorLike:
         """Forward apply with global-shape check
-        (ref ``LinearOperator.py:170-192``). Opens a diagnostics span
-        (``PYLOPS_MPI_TPU_TRACE``) tagged with the operator class,
-        shape, dtype and mesh axes; compositions nest naturally."""
+        (ref ``LinearOperator.py:170-192``). Accepts ``(N,)`` or the
+        block form ``(N, K)`` — K model columns through one apply.
+        Opens a diagnostics span (``PYLOPS_MPI_TPU_TRACE``) tagged with
+        the operator class, shape, dtype and mesh axes; compositions
+        nest naturally."""
         M, N = self.shape
-        if isinstance(x, DistributedArray) and x.global_shape != (N,):
+        block = (isinstance(x, DistributedArray) and x.ndim == 2
+                 and x.global_shape[0] == N)
+        if isinstance(x, DistributedArray) and not block \
+                and x.global_shape != (N,):
             raise ValueError(
                 f"dimension mismatch: operator {self.shape}, x {x.global_shape}")
         from .diagnostics import trace
         with trace.op_span(self, "matvec"):
+            if block and not self.accepts_block:
+                return self._apply_columns(x, forward=True)
             return self._matvec(x)
 
     def rmatvec(self, x: VectorLike) -> VectorLike:
         """Adjoint apply with global-shape check
-        (ref ``LinearOperator.py:206-230``). Traced like
-        :meth:`matvec`."""
+        (ref ``LinearOperator.py:206-230``). Accepts ``(M,)`` or the
+        block form ``(M, K)``; traced like :meth:`matvec`."""
         M, N = self.shape
-        if isinstance(x, DistributedArray) and x.global_shape != (M,):
+        block = (isinstance(x, DistributedArray) and x.ndim == 2
+                 and x.global_shape[0] == M)
+        if isinstance(x, DistributedArray) and not block \
+                and x.global_shape != (M,):
             raise ValueError(
                 f"dimension mismatch: operator {self.shape}, x {x.global_shape}")
         from .diagnostics import trace
         with trace.op_span(self, "rmatvec"):
+            if block and not self.accepts_block:
+                return self._apply_columns(x, forward=False)
             return self._rmatvec(x)
+
+    def _apply_columns(self, x: "DistributedArray", forward: bool):
+        """Generic block fallback: ``jax.vmap`` the single-column apply
+        over the trailing axis — one traced program for all K columns.
+        Operators with a native widened contraction (``accepts_block``)
+        never reach this."""
+        import jax
+        fn = self._matvec if forward else self._rmatvec
+        row_locals = tuple((s[0],) for s in x.local_shapes)
+        tmpl = {}
+
+        def one(col):
+            xi = DistributedArray._wrap(
+                col, x, global_shape=(x.global_shape[0],),
+                local_shapes=row_locals)
+            yi = fn(xi)
+            if not isinstance(yi, DistributedArray):
+                raise TypeError(
+                    f"{type(self).__name__}: block apply supports "
+                    f"DistributedArray results only, got "
+                    f"{type(yi).__name__}")
+            tmpl["like"] = yi
+            return yi._arr
+
+        out = jax.vmap(one, in_axes=1, out_axes=1)(x._arr)
+        like = tmpl["like"]
+        K = x.global_shape[1]
+        return DistributedArray._wrap(
+            out, like, global_shape=like.global_shape + (K,),
+            local_shapes=tuple(tuple(s) + (K,) for s in like.local_shapes))
 
     def _wrap_local(self, y, x: "DistributedArray", n: int):
         out = DistributedArray(global_shape=n, mesh=x.mesh,
@@ -125,6 +175,8 @@ class MPILinearOperator:
             return _ScaledLinearOperator(self, x)
         if isinstance(x, StackedDistributedArray) or x.ndim == 1:
             return self.matvec(x)
+        if x.ndim == 2 and x.global_shape[0] == self.shape[1]:
+            return self.matvec(x)  # block (column-batched) apply
         raise ValueError(f"expected 1-d DistributedArray, got {x.global_shape!r}")
 
     def adjoint(self):
@@ -225,6 +277,12 @@ LinearOperator = MPILinearOperator
 class _AdjointLinearOperator(MPILinearOperator):
     """ref ``LinearOperator.py:408-421``"""
 
+    # all lazy wrappers delegate through the sub-operators' PUBLIC
+    # matvec/rmatvec (which route block inputs to the child's native
+    # widened contraction or its vmap fallback), so the wrappers
+    # themselves accept the column axis
+    accepts_block = True
+
     def __init__(self, A: MPILinearOperator):
         self.dims, self.dimsd = A.dimsd, A.dims
         super().__init__(shape=(A.shape[1], A.shape[0]), dtype=A.dtype)
@@ -237,14 +295,16 @@ class _AdjointLinearOperator(MPILinearOperator):
         return self.args[0]
 
     def _matvec(self, x):
-        return self.A._rmatvec(x)
+        return self.A.rmatvec(x)
 
     def _rmatvec(self, x):
-        return self.A._matvec(x)
+        return self.A.matvec(x)
 
 
 class _TransposedLinearOperator(MPILinearOperator):
     """transpose = conj ∘ rmatvec ∘ conj (ref ``LinearOperator.py:424-443``)"""
+
+    accepts_block = True
 
     def __init__(self, A: MPILinearOperator):
         self.dims, self.dimsd = A.dimsd, A.dims
@@ -256,14 +316,16 @@ class _TransposedLinearOperator(MPILinearOperator):
         return self.args[0]  # see _AdjointLinearOperator.A
 
     def _matvec(self, x):
-        return self.A._rmatvec(x.conj()).conj()
+        return self.A.rmatvec(x.conj()).conj()
 
     def _rmatvec(self, x):
-        return self.A._matvec(x.conj()).conj()
+        return self.A.matvec(x.conj()).conj()
 
 
 class _ProductLinearOperator(MPILinearOperator):
     """ref ``LinearOperator.py:446-466``"""
+
+    accepts_block = True
 
     def __init__(self, A: MPILinearOperator, B: MPILinearOperator):
         if A.shape[1] != B.shape[0]:
@@ -286,6 +348,8 @@ class _ProductLinearOperator(MPILinearOperator):
 
 class _ScaledLinearOperator(MPILinearOperator):
     """ref ``LinearOperator.py:469-496``"""
+
+    accepts_block = True
 
     def __init__(self, A: MPILinearOperator, alpha):
         if not np.isscalar(alpha):
@@ -315,6 +379,8 @@ class _ScaledLinearOperator(MPILinearOperator):
 class _SumLinearOperator(MPILinearOperator):
     """ref ``LinearOperator.py:499-524``"""
 
+    accepts_block = True
+
     def __init__(self, A: MPILinearOperator, B: MPILinearOperator):
         if A.shape != B.shape:
             raise ValueError(f"cannot add {A} and {B}: shape mismatch")
@@ -335,6 +401,8 @@ class _SumLinearOperator(MPILinearOperator):
 
 class _PowerLinearOperator(MPILinearOperator):
     """repeat-apply (ref ``LinearOperator.py:527-552``)"""
+
+    accepts_block = True
 
     def __init__(self, A: MPILinearOperator, p: int):
         if A.shape[0] != A.shape[1]:
@@ -365,6 +433,8 @@ class _PowerLinearOperator(MPILinearOperator):
 class _ConjLinearOperator(MPILinearOperator):
     """ref ``LinearOperator.py:555-580``"""
 
+    accepts_block = True
+
     def __init__(self, A: MPILinearOperator):
         self.dims, self.dimsd = A.dims, A.dimsd
         super().__init__(shape=A.shape, dtype=A.dtype)
@@ -375,10 +445,10 @@ class _ConjLinearOperator(MPILinearOperator):
         return self.args[0]  # see _AdjointLinearOperator.A
 
     def _matvec(self, x):
-        return self.A._matvec(x.conj()).conj()
+        return self.A.matvec(x.conj()).conj()
 
     def _rmatvec(self, x):
-        return self.A._rmatvec(x.conj()).conj()
+        return self.A.rmatvec(x.conj()).conj()
 
     def _adjoint(self):
         return _ConjLinearOperator(self.A.H)
@@ -388,6 +458,8 @@ class _CheckpointedLinearOperator(MPILinearOperator):
     """Remat wrapper: matvec/rmatvec run under :func:`jax.checkpoint` so
     reverse-mode AD recomputes their intermediates instead of storing
     them (TPU HBM lever for long composed chains)."""
+
+    accepts_block = True
 
     # layout metadata forwarded so dottest/todense/solvers see the same
     # shard layout on the wrapper as on the wrapped operator

@@ -111,12 +111,14 @@ class MPIBlockDiag(MPILinearOperator):
         if self._normal_path is None and self._batched is not None:
             from ..tuning import plan as _tuneplan
             nblk, m, n = self._batched.shape
+            from ..utils.deps import batch_default
             tplan = _tuneplan.get_plan(
                 "blockdiag", shape=self.shape, dtype=self.dtype,
                 mesh=self.mesh,
                 extra={"fused_available": bool(self.has_fused_normal),
                        "a_bytes": float(
-                           nblk * m * n * self._batched.dtype.itemsize)})
+                           nblk * m * n * self._batched.dtype.itemsize),
+                       "batch": batch_default()})
             if tplan is not None \
                     and tplan.get("normal_path") in ("fused",
                                                      "two_sweep"):
@@ -152,24 +154,42 @@ class MPIBlockDiag(MPILinearOperator):
         from ..parallel.mesh import axis_sharding
         return jax.device_put(A, axis_sharding(self.mesh, 3, 0))
 
+    # block (column-batched) inputs reuse the SAME batched einsum with a
+    # widened trailing contraction — no per-column Python loop
+    accepts_block = True
+
     def _apply(self, x: DistributedArray, forward: bool) -> DistributedArray:
         sizes_in = self.mops if forward else self.nops
         sizes_out = self.nops if forward else self.mops
         locals_out = self.local_shapes_n if forward else self.local_shapes_m
         y_shape = self.shape[0] if forward else self.shape[1]
+        ncol = x.global_shape[1] if x.ndim == 2 else None
         if self._batched is not None:
             from ._precision import einsum_narrow
             A = self._batched
             nblk, m, n = A.shape
             k = self._batched_k
-            X = x.array.reshape(nblk, n if forward else m, k)
+            nin = n if forward else m
+            if ncol is None:
+                X = x.array.reshape(nblk, nin, k)
+            else:
+                # K model columns fold into the existing GEMM columns:
+                # the contraction widens from k to k*K, one einsum
+                X = x.array.reshape(nblk, nin, k, ncol) \
+                    .reshape(nblk, nin, k * ncol)
             if forward:
                 Y = einsum_narrow("bmn,bnk->bmk", A, X,
                                   self.compute_dtype, self.dtype)
             else:
                 Y = einsum_narrow("bnm,bnk->bmk", A.conj(), X,
                                   self.compute_dtype, self.dtype)
-            arr = Y.ravel()
+            nout = Y.shape[1]
+            arr = (Y.ravel() if ncol is None
+                   else Y.reshape(nblk, nout, k, ncol)
+                   .reshape(y_shape, ncol))
+        elif ncol is not None:
+            # heterogeneous blocks: one compiled vmap over columns
+            return self._apply_columns(x, forward)
         else:
             offs = np.concatenate([[0], np.cumsum(sizes_in)])
             parts = []
@@ -177,6 +197,9 @@ class MPIBlockDiag(MPILinearOperator):
                 xb = x.array[int(lo):int(hi)]
                 parts.append(op.matvec(xb) if forward else op.rmatvec(xb))
             arr = jnp.concatenate(parts)
+        if ncol is not None:
+            y_shape = (y_shape, ncol)
+            locals_out = tuple(tuple(s) + (ncol,) for s in locals_out)
         y = DistributedArray(global_shape=y_shape, mesh=self.mesh,
                              partition=x.partition, axis=0,
                              local_shapes=locals_out, mask=self.mask,
@@ -231,7 +254,10 @@ class MPIBlockDiag(MPILinearOperator):
         does the same against DRAM (measured 1.6x the two-sweep
         einsum pair at the 4096² flagship block). Falls back to
         matvec+rmatvec otherwise."""
-        if not self.has_fused_normal:
+        # the fused kernels are vector-form: block (column-batched)
+        # inputs take the generic two-sweep path, whose widened einsums
+        # carry the column axis natively
+        if not self.has_fused_normal or x.ndim == 2:
             return super().normal_matvec(x)
         from jax.sharding import PartitionSpec as P
         from ..jaxcompat import shard_map

@@ -160,15 +160,22 @@ class MPIFredholm1(MPILinearOperator):
             "nsl % n_devices == 0 and planar=False); got "
             f"{x.partition} with local sizes {tuple(x._axis_sizes)}")
 
+    # block (column-batched) inputs fold their K columns into the
+    # trailing z dimension of the SAME batched contraction (z -> z*K)
+    accepts_block = True
+
     def _wrap(self, arr, x: DistributedArray, n: int,
-              inner: int) -> DistributedArray:
+              inner: int, ncol=None) -> DistributedArray:
         shapes = None
         if x.partition == Partition.SCATTER:
             shapes = self._slice_shapes(inner)
-        y = DistributedArray(global_shape=n, mesh=x.mesh,
+            if shapes is not None and ncol is not None:
+                shapes = tuple(tuple(s) + (ncol,) for s in shapes)
+        gshape = n if ncol is None else (n, ncol)
+        y = DistributedArray(global_shape=gshape, mesh=x.mesh,
                              partition=x.partition, local_shapes=shapes,
                              dtype=self.dtype)
-        y[:] = arr.ravel()
+        y[:] = arr.ravel() if ncol is None else arr.reshape(-1, ncol)
         return y
 
     def _contract(self, spec, K, v):
@@ -182,7 +189,9 @@ class MPIFredholm1(MPILinearOperator):
 
     def _matvec(self, x: DistributedArray) -> DistributedArray:
         self._check_partition(x, self.ny)
-        m = x.array.reshape(self.dims)
+        ncol = int(x.global_shape[1]) if x.ndim == 2 else None
+        m = x.array.reshape(self.dims if ncol is None
+                            else self.dims[:-1] + (self.nz * ncol,))
         if self.planar:
             # complex batched GEMM on plane pairs, 4 real einsums (the
             # Karatsuba 3-einsum form needs a kernel-sized Gr+Gi temp —
@@ -195,11 +204,13 @@ class MPIFredholm1(MPILinearOperator):
             d = jnp.stack([dr, di])
         else:
             d = self._contract("kxy,kyz->kxz", self.G, m)
-        return self._wrap(d, x, self.shape[0], self.nx)
+        return self._wrap(d, x, self.shape[0], self.nx, ncol)
 
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
         self._check_partition(x, self.nx)
-        d = x.array.reshape(self.dimsd)
+        ncol = int(x.global_shape[1]) if x.ndim == 2 else None
+        d = x.array.reshape(self.dimsd if ncol is None
+                            else self.dimsd[:-1] + (self.nz * ncol,))
         if self.planar:
             if self.GT is not None:
                 Hr, Hi = self.GT[0], self.GT[1]
@@ -214,7 +225,7 @@ class MPIFredholm1(MPILinearOperator):
             GT = self.GT if self.GT is not None \
                 else jnp.conj(self.G).transpose(0, 2, 1)
             m = self._contract("kyx,kxz->kyz", GT, d)
-        return self._wrap(m, x, self.shape[1], self.ny)
+        return self._wrap(m, x, self.shape[1], self.ny, ncol)
 
 
 # the frequency-sharded kernel travels into jit as a pytree child

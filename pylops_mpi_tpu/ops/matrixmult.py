@@ -124,6 +124,9 @@ class _MatMulBase(MPILinearOperator):
     # subclasses whose adjoint never reads At set this False
     # (see _MPISummaMatrixMult: its kernels use the sharded Ap tiles)
     _uses_At = True
+    # K model columns fold into the GEMM's existing column dimension
+    # (M -> M*K) — same kernels, widened contraction, no per-column loop
+    accepts_block = True
 
     def __init__(self, A, M: int, mesh=None, dtype=None, saveAt: bool = False,
                  compute_dtype=None):
@@ -175,12 +178,31 @@ class _MatMulBase(MPILinearOperator):
     def _place_A(self, A):
         return A
 
+    def _fold_in(self, x: DistributedArray, nrows: int):
+        """Reshape the flat model/data vector into the 2-D GEMM operand.
+
+        Plain ``(nrows*M,)`` input gives the usual ``(nrows, M)``; a
+        block ``(nrows*M, K)`` input folds its K columns into the GEMM
+        columns — ``(nrows, M*K)`` — so every schedule below moves K
+        columns per step with zero structural change. Returns
+        ``(operand, ncol)`` with ``ncol=None`` for the vector case.
+        """
+        if x.ndim == 2:
+            ncol = int(x.global_shape[1])
+            return (x.array.reshape(nrows, self.M, ncol)
+                    .reshape(nrows, self.M * ncol)), ncol
+        return x.array.reshape(nrows, self.M), None
+
     def _wrap_out(self, arr: jax.Array, x: DistributedArray,
-                  nrows: int) -> DistributedArray:
-        y = DistributedArray(global_shape=nrows * self.M, mesh=x.mesh,
+                  nrows: int, ncol=None) -> DistributedArray:
+        gshape = nrows * self.M if ncol is None else (nrows * self.M, ncol)
+        y = DistributedArray(global_shape=gshape, mesh=x.mesh,
                              partition=Partition.SCATTER, axis=0,
                              mask=x.mask, dtype=arr.dtype)
-        y[:] = arr.ravel()
+        if ncol is None:
+            y[:] = arr.ravel()
+        else:
+            y[:] = arr.reshape(nrows, self.M, ncol).reshape(-1, ncol)
         return y
 
 
@@ -199,15 +221,15 @@ class _MPIBlockMatrixMult(_MatMulBase):
             return A  # rows not divisible by P: let XLA choose placement
 
     def _matvec(self, x: DistributedArray) -> DistributedArray:
-        X = x.array.reshape(self.K, self.M)
-        Y = self._gemm(self.A, X)           # (N, M) row-sharded
-        return self._wrap_out(Y, x, self.N)
+        X, ncol = self._fold_in(x, self.K)
+        Y = self._gemm(self.A, X)           # (N, M[*K]) row-sharded
+        return self._wrap_out(Y, x, self.N, ncol)
 
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
-        Y = x.array.reshape(self.N, self.M)
+        Y, ncol = self._fold_in(x, self.N)
         At = self.At if self.At is not None else jnp.conj(self.A).T
         X = self._gemm(At, Y)               # sharded-N contraction → psum
-        return self._wrap_out(X, x, self.K)
+        return self._wrap_out(X, x, self.K, ncol)
 
 
 class _MPISummaMatrixMult(_MatMulBase):
@@ -347,10 +369,12 @@ class _MPISummaMatrixMult(_MatMulBase):
             dx = DistributedArray.to_dist(x, mesh=base)
             return lambda: jax.block_until_ready(op.matvec(dx).array)
 
+        from ..utils.deps import batch_default
         return _tuneplan.get_plan(
             "matrixmult", shape=(N_, K_, int(M)),
             dtype=dtype if dtype is not None else getattr(A, "dtype", None),
-            mesh=base, extra={"grid": tuple(int(g) for g in self.grid)},
+            mesh=base, extra={"grid": tuple(int(g) for g in self.grid),
+                              "batch": batch_default()},
             factory=factory)
 
     def _place_A(self, A):
@@ -421,7 +445,9 @@ class _MPISummaMatrixMult(_MatMulBase):
         if self.Kp_c > self.Kp_r:
             Xfull = jnp.pad(Xfull, ((0, self.Kp_c - self.Kp_r), (0, 0)))
         kb = self.Kp_c // pc
-        mb = self.Mp // pc
+        # chunk width from the operand, not self.Mp: block inputs widen
+        # M to M*K and the ring then moves K columns per hop
+        mb = Xfull.shape[1] // pc
         c = lax.axis_index("c")
         Xk = lax.dynamic_slice_in_dim(Xfull, c * kb, kb, axis=0)
 
@@ -446,7 +472,7 @@ class _MPISummaMatrixMult(_MatMulBase):
         # 'r' psum of the K-block partials is unchanged.
         from ..parallel.collectives import ring_pass
         pc = self.grid[1]
-        mb = self.Mp // pc
+        mb = Yblk.shape[1]  # = Mp_eff // pc; block inputs widen Mp
         c = lax.axis_index("c")
         At = jnp.conj(Ablk).T
         parts = []
@@ -473,7 +499,10 @@ class _MPISummaMatrixMult(_MatMulBase):
 
     def _matvec(self, x: DistributedArray) -> DistributedArray:
         pr, pc = self.grid
-        X = _pad_to(x.array.reshape(self.K, self.M), self.Kp_r, self.Mp)
+        X, ncol = self._fold_in(x, self.K)
+        Me = X.shape[1]                       # M, or M*K for block input
+        Mp = pc * int(np.ceil(Me / pc))
+        X = _pad_to(X, self.Kp_r, Mp)
         ring = self.overlap and pc > 1
         if self.schedule == "stat_a":
             kernel = (self._kernel_fwd_stat_a_ring if ring
@@ -483,16 +512,20 @@ class _MPISummaMatrixMult(_MatMulBase):
         Y = shard_map(kernel, mesh=self.mesh2,
                       in_specs=(P("r", "c"), P("r", "c")),
                       out_specs=P("r", "c"), check_vma=False)(self.Ap, X)
-        return self._wrap_out(Y[:self.N, :self.M], x, self.N)
+        return self._wrap_out(Y[:self.N, :Me], x, self.N, ncol)
 
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
-        Y = _pad_to(x.array.reshape(self.N, self.M), self.Np, self.Mp)
+        pc = self.grid[1]
+        Y, ncol = self._fold_in(x, self.N)
+        Me = Y.shape[1]
+        Mp = pc * int(np.ceil(Me / pc))
+        Y = _pad_to(Y, self.Np, Mp)
         kernel = (self._kernel_adj_ring
-                  if self.overlap and self.grid[1] > 1 else self._kernel_adj)
+                  if self.overlap and pc > 1 else self._kernel_adj)
         X = shard_map(kernel, mesh=self.mesh2,
                       in_specs=(P("r", "c"), P("r", "c")),
                       out_specs=P("c", None), check_vma=False)(self.Ap, Y)
-        return self._wrap_out(X[:self.K, :self.M], x, self.K)
+        return self._wrap_out(X[:self.K, :Me], x, self.K, ncol)
 
 
 class _MPIAutoMatrixMult(_MatMulBase):
@@ -517,15 +550,15 @@ class _MPIAutoMatrixMult(_MatMulBase):
             return A  # non-divisible tiles: leave placement to XLA
 
     def _matvec(self, x: DistributedArray) -> DistributedArray:
-        X = x.array.reshape(self.K, self.M)
+        X, ncol = self._fold_in(x, self.K)
         Y = self._gemm(self.A, X)
-        return self._wrap_out(Y, x, self.N)
+        return self._wrap_out(Y, x, self.N, ncol)
 
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
-        Y = x.array.reshape(self.N, self.M)
+        Y, ncol = self._fold_in(x, self.N)
         At = self.At if self.At is not None else jnp.conj(self.A).T
         X = self._gemm(At, Y)
-        return self._wrap_out(X, x, self.K)
+        return self._wrap_out(X, x, self.K, ncol)
 
 
 def MPIMatrixMult(A, M: int, saveAt: bool = False, mesh=None,

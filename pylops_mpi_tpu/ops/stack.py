@@ -75,8 +75,10 @@ class MPIVStack(MPILinearOperator):
         from ..utils.deps import overlap_env_pinned
         if overlap is None and not overlap_env_pinned():
             from ..tuning import plan as _tuneplan
+            from ..utils.deps import batch_default
             tplan = _tuneplan.get_plan("stack", shape=shape,
-                                       dtype=dtype, mesh=self.mesh)
+                                       dtype=dtype, mesh=self.mesh,
+                                       extra={"batch": batch_default()})
             if tplan is not None \
                     and tplan.get("overlap") in ("on", "off"):
                 overlap = tplan.get("overlap")
@@ -117,26 +119,39 @@ class MPIVStack(MPILinearOperator):
         from ..parallel.mesh import axis_sharding
         return jax.device_put(A, axis_sharding(self.mesh, 3, 0)), adjs[0]
 
+    # block (column-batched) inputs add a trailing index to the SAME
+    # batched einsums — one widened GEMM, no per-column Python loop
+    accepts_block = True
+
     def _matvec(self, x: DistributedArray) -> DistributedArray:
         # model is replicated (ref requires Partition.BROADCAST input,
         # VStack.py:123-133)
         xg = x.array
+        ncol = int(x.global_shape[1]) if x.ndim == 2 else None
         if self._batched is not None:
             A, adj = self._batched, self._batched_adj
             # replicated x against the block-sharded stack: zero
             # communication, output lands SCATTER over blocks
             if adj:
-                Y = einsum_narrow("bmn,m->bn", A.conj(), xg,
+                Y = einsum_narrow("bmn,m->bn" if ncol is None
+                                  else "bmn,mk->bnk", A.conj(), xg,
                                   self.compute_dtype, self.dtype)
             else:
-                Y = einsum_narrow("bmn,n->bm", A, xg,
+                Y = einsum_narrow("bmn,n->bm" if ncol is None
+                                  else "bmn,nk->bmk", A, xg,
                                   self.compute_dtype, self.dtype)
-            arr = Y.ravel()
+            arr = Y.ravel() if ncol is None else Y.reshape(-1, ncol)
+        elif ncol is not None:
+            # heterogeneous rows: one compiled vmap over columns
+            return self._apply_columns(x, forward=True)
         else:
             arr = jnp.concatenate([op.matvec(xg) for op in self.ops])
-        y = DistributedArray(global_shape=self.shape[0], mesh=self.mesh,
+        gshape = self.shape[0] if ncol is None else (self.shape[0], ncol)
+        lsh = (self.local_shapes_n if ncol is None
+               else tuple(tuple(s) + (ncol,) for s in self.local_shapes_n))
+        y = DistributedArray(global_shape=gshape, mesh=self.mesh,
                              partition=Partition.SCATTER, axis=0,
-                             local_shapes=self.local_shapes_n,
+                             local_shapes=lsh,
                              mask=self.mask, dtype=arr.dtype)
         y[:] = arr
         return y
@@ -158,12 +173,15 @@ class MPIVStack(MPILinearOperator):
         P_ = int(self.mesh.devices.size)
         name = self.mesh.axis_names[0]
         nblk = A.shape[0]
+        ncol = int(x.global_shape[1]) if x.ndim == 2 else None
         if adj:
             spec, out_len, conj, sl_axis, in_cols = (
-                "bmn,bn->m", A.shape[1], False, 1, A.shape[2])
+                "bmn,bn->m" if ncol is None else "bmn,bnk->mk",
+                A.shape[1], False, 1, A.shape[2])
         else:
             spec, out_len, conj, sl_axis, in_cols = (
-                "bmn,bm->n", A.shape[2], True, 2, A.shape[1])
+                "bmn,bm->n" if ncol is None else "bmn,bmk->nk",
+                A.shape[2], True, 2, A.shape[1])
         cw = -(-out_len // P_)
         Dp = P_ * cw
         cd, dt = self.compute_dtype, self.dtype
@@ -174,7 +192,8 @@ class MPIVStack(MPILinearOperator):
                 pad = [(0, 0)] * 3
                 pad[sl_axis] = (0, Dp - out_len)
                 Ab = _jnp.pad(Ab, pad)
-            xl = xb.reshape(nblk // P_, in_cols)
+            xl = xb.reshape((nblk // P_, in_cols) if ncol is None
+                            else (nblk // P_, in_cols, ncol))
 
             def chunk(j):
                 As = lax.dynamic_slice_in_dim(Ab, j * cw, cw,
@@ -201,6 +220,7 @@ class MPIVStack(MPILinearOperator):
         return full[:out_len]
 
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
+        ncol = int(x.global_shape[1]) if x.ndim == 2 else None
         if self._batched is not None:
             A, adj = self._batched, self._batched_adj
             nblk = A.shape[0]
@@ -210,20 +230,27 @@ class MPIVStack(MPILinearOperator):
             # the partitioner lowers the contraction to one psum, the
             # reference's sum-allreduce (ref VStack.py:135-150)
             elif adj:
-                acc = einsum_narrow("bmn,bn->m", A,
-                                    x.array.reshape(nblk, A.shape[2]),
+                xr = x.array.reshape((nblk, A.shape[2]) if ncol is None
+                                     else (nblk, A.shape[2], ncol))
+                acc = einsum_narrow("bmn,bn->m" if ncol is None
+                                    else "bmn,bnk->mk", A, xr,
                                     self.compute_dtype, self.dtype)
             else:
-                acc = einsum_narrow("bmn,bm->n", A.conj(),
-                                    x.array.reshape(nblk, A.shape[1]),
+                xr = x.array.reshape((nblk, A.shape[1]) if ncol is None
+                                     else (nblk, A.shape[1], ncol))
+                acc = einsum_narrow("bmn,bm->n" if ncol is None
+                                    else "bmn,bmk->nk", A.conj(), xr,
                                     self.compute_dtype, self.dtype)
+        elif ncol is not None:
+            return self._apply_columns(x, forward=False)
         else:
             offs = np.concatenate([[0], np.cumsum(self.nops)])
             acc = None
             for op, lo, hi in zip(self.ops, offs[:-1], offs[1:]):
                 part = op.rmatvec(x.array[int(lo):int(hi)])
                 acc = part if acc is None else acc + part
-        y = DistributedArray(global_shape=self.shape[1], mesh=self.mesh,
+        gshape = self.shape[1] if ncol is None else (self.shape[1], ncol)
+        y = DistributedArray(global_shape=gshape, mesh=self.mesh,
                              partition=Partition.BROADCAST,
                              mask=self.mask, dtype=acc.dtype)
         y[:] = acc
@@ -256,6 +283,8 @@ class MPIStackedVStack(MPIStackedLinearOperator):
 class MPIHStack(MPILinearOperator):
     """Horizontal stack, implemented as the adjoint of a VStack of
     adjoints — exactly the reference's trick (ref ``HStack.py:98-100``)."""
+
+    accepts_block = True  # delegates to the block-capable VStack paths
 
     def __init__(self, ops: Sequence[LocalOperator],
                  mask: Optional[Sequence[int]] = None,

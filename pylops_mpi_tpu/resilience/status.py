@@ -47,8 +47,8 @@ from ..diagnostics import trace as _trace
 
 __all__ = ["RUNNING", "CONVERGED", "MAXITER", "BREAKDOWN", "STAGNATION",
            "STATUS_NAMES", "status_name", "guards_mode", "guards_enabled",
-           "stall_window", "guards_signature", "record", "last_status",
-           "clear_statuses"]
+           "stall_window", "guards_signature", "record", "record_columns",
+           "last_status", "clear_statuses"]
 
 # in-carry status word values (int32 scalars inside the while_loop)
 RUNNING = 0
@@ -135,6 +135,22 @@ _LAST: Dict[str, Dict] = {}
 def record(solver: str, code: int, iiter: int) -> None:
     info = {"status": int(code), "status_name": status_name(code),
             "iiter": int(iiter)}
+    with _LOCK:
+        _LAST[solver] = info
+    _trace.event("solver.status", cat="resilience", solver=solver, **info)
+
+
+def record_columns(solver: str, codes, iiter: int) -> None:
+    """Per-column status words of a guarded BLOCK solve (one code per
+    RHS column; solvers/block.py). ``status`` keeps the WORST column —
+    the scalar consumers (resilient_solve triage, the trace viewer)
+    see a block solve degrade exactly like a single-RHS one — and the
+    full vector lands under ``"columns"``/``"column_names"``."""
+    codes = [int(c) for c in codes]
+    worst = max(codes) if codes else CONVERGED
+    info = {"status": worst, "status_name": status_name(worst),
+            "iiter": int(iiter), "columns": codes,
+            "column_names": [status_name(c) for c in codes]}
     with _LOCK:
         _LAST[solver] = info
     _trace.event("solver.status", cat="resilience", solver=solver, **info)

@@ -2,4 +2,6 @@ from .basic import (CG, CGLS, cg, cgls, cg_guarded, cgls_guarded,
                     clear_fused_cache)
 from .sparsity import ISTA, FISTA, ista, fista, ista_guarded, fista_guarded
 from .segmented import cg_segmented, cgls_segmented, SegmentedResult
+from .block import (block_cg, block_cgls, block_cg_segmented,
+                    batched_solve, BatchedResult)
 from .eigs import power_iteration

@@ -148,6 +148,10 @@ def plan_key(op: str, shape, dtype=None, n_dev: Optional[int] = None,
     key = f"{op}|s{bucket}|{dt}|mesh[{ax}]x{nd}|{platform}:{chip}"
     if extra and extra.get("grid"):
         key += f"|grid{tuple(int(g) for g in extra['grid'])}"
+    # block width changes the measured regime (K columns per GEMM /
+    # ring step); K=1 keeps the historical key so existing caches hit
+    if extra and extra.get("batch") and int(extra["batch"]) != 1:
+        key += f"|b{int(extra['batch'])}"
     return key
 
 

@@ -141,11 +141,23 @@ def _overlap_seed(context: Dict, params: Dict, ici_bytes: float,
         + max(0, steps) * _dispatch_s(context)
 
 
+def _batch_of(context: Dict) -> int:
+    """Block width of the solve the plan will serve (``extra["batch"]``,
+    default 1). Seeds scale their per-apply work by it — K columns ride
+    the same schedule — so batch=1 costs (and therefore batch=1 plans)
+    are EXACTLY the pre-batching ones."""
+    try:
+        return max(1, int(context.get("extra", {}).get("batch") or 1))
+    except (TypeError, ValueError):
+        return 1
+
+
 def _cost_matrixmult(context: Dict, params: Dict) -> Optional[float]:
     shape = context.get("shape")
     if not shape or len(shape) != 3:
         return None
     N, K, M = (int(s) for s in shape)
+    M *= _batch_of(context)  # K RHS columns widen the model dimension
     grid = tuple(context.get("extra", {}).get("grid") or (1, 1))
     pr, pc = max(1, int(grid[0])), max(1, int(grid[1]))
     P = pr * pc
@@ -203,9 +215,14 @@ def _cost_blockdiag(context: Dict, params: Dict) -> Optional[float]:
     # path streams the block stack ONCE per (u, q) pair, the two-sweep
     # einsum pair twice — the whole reason the kernel exists
     sweeps = 1.0 if params.get("normal_path") == "fused" else 2.0
+    # the block stack streams ONCE for all K columns (the batching
+    # amortization); only the per-column vector traffic scales, which
+    # the seed folds in as a small linear term so batch=1 is unchanged
+    b = _batch_of(context)
     if not pk.get("hbm_gbps"):
         return sweeps
-    return sweeps * a_bytes / P / (pk["hbm_gbps"] * 1e9)
+    return sweeps * a_bytes * (1.0 + 0.01 * (b - 1)) / P \
+        / (pk["hbm_gbps"] * 1e9)
 
 
 def _cost_stack(context: Dict, params: Dict) -> Optional[float]:
@@ -214,7 +231,7 @@ def _cost_stack(context: Dict, params: Dict) -> Optional[float]:
         return None
     P = max(1, int(context.get("n_dev") or 1))
     it = _itemsize(context)
-    out_len = int(shape[-1])
+    out_len = int(shape[-1]) * _batch_of(context)
     ici = out_len * it * 2.0 * (P - 1) / max(1, P)  # adjoint psum
     return _overlap_seed(context, params, ici, steps=P - 1)
 
@@ -337,11 +354,13 @@ register_space(TuningSpace(
     op="matrixmult",
     axes=(Axis("schedule", ("gather", "stat_a")),
           Axis("overlap", ("off", "on")),
-          Axis("comm_chunks", (1,), fixed=True)),
+          Axis("comm_chunks", (1,), fixed=True),
+          Axis("batch", (1, 2, 4, 8, 16, 32, 64), fixed=True)),
     cost=_cost_matrixmult,
     default_fn=_default_matrixmult,
     note="SUMMA forward schedule x ring overlap; chunking is carried "
-         "by the ring step count, recorded for provenance only"))
+         "by the ring step count, recorded for provenance only; batch "
+         "is the solve's block width (keyed, never searched)"))
 
 register_space(TuningSpace(
     op="fft",
@@ -357,7 +376,8 @@ register_space(TuningSpace(
 register_space(TuningSpace(
     op="blockdiag",
     axes=(Axis("normal_path", ("fused", "two_sweep")),
-          Axis("tile", ("kernel_default",), fixed=True)),
+          Axis("tile", ("kernel_default",), fixed=True),
+          Axis("batch", (1, 2, 4, 8, 16, 32, 64), fixed=True)),
     cost=_cost_blockdiag,
     enumerate_fn=_enum_blockdiag,
     note="fused (Pallas/XLA-FFI one-sweep) vs two-sweep normal "
@@ -366,7 +386,8 @@ register_space(TuningSpace(
 
 register_space(TuningSpace(
     op="stack",
-    axes=(Axis("overlap", ("off", "on")),),
+    axes=(Axis("overlap", ("off", "on")),
+          Axis("batch", (1, 2, 4, 8, 16, 32, 64), fixed=True)),
     cost=_cost_stack,
     note="batched adjoint reduction: partitioner psum vs explicit "
          "ring reduce-scatter"))

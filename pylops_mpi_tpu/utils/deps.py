@@ -54,6 +54,7 @@ import os
 __all__ = ["jax_enabled", "platform_override", "x64_enabled",
            "explicit_stencil_enabled", "apply_environment",
            "overlap_mode", "overlap_enabled", "comm_chunks_default",
+           "batch_default",
            "overlap_env_pinned", "comm_chunks_env_pinned",
            "KNOBS", "knob_names", "knob_table_markdown"]
 
@@ -162,6 +163,10 @@ KNOBS = [
      "how many seed-ranked candidates get timed"),
     ("PYLOPS_MPI_TPU_TUNE_MARGIN", "float", "0.02", "tuning/search.py",
      "fractional win required to move off the default plan"),
+    ("PYLOPS_MPI_TPU_BATCH", "int>=1", "1",
+     "utils/deps.py (benchmarks, tuning contexts)",
+     "default RHS-column count K of the batched solve paths (block "
+     "solvers' bench race width; carried into plan-cache keys)"),
     ("PYLOPS_MPI_TPU_TEST_DEVICES", "int", "8",
      "tests/conftest.py, .github/workflows/build.yml",
      "virtual-device count of the CPU-sim test mesh"),
@@ -295,6 +300,19 @@ def comm_chunks_env_pinned() -> bool:
     (even to the default value) — same tuner-precedence rule as
     :func:`overlap_env_pinned`."""
     return "PYLOPS_MPI_TPU_COMM_CHUNKS" in os.environ
+
+
+def batch_default() -> int:
+    """Default RHS-column count ``K`` of the batched solve paths
+    (``PYLOPS_MPI_TPU_BATCH``, default 1 = single-RHS; floored at 1).
+    Consumed by the benchmark's batched-throughput race and forwarded
+    into plan-cache contexts (``extra["batch"]``) so a plan measured
+    at one block width is never replayed at another."""
+    try:
+        v = int(os.environ.get("PYLOPS_MPI_TPU_BATCH", "1"))
+    except ValueError:
+        v = 1
+    return max(1, v)
 
 
 def comm_chunks_default() -> int:

@@ -176,10 +176,10 @@ def test_run_json_cmd_salvages_on_timeout(bench):
     # generous timeout: under a loaded host (xdist workers) the child
     # needs a few seconds just to start python and print
     got, err = bench._run_json_cmd([sys.executable, "-c", code],
-                                   dict(os.environ), timeout=15)
+                                   dict(os.environ), timeout=8)
     assert err is None
     assert got["value"] == 7.5
-    assert got["salvaged_after_timeout"] == 15
+    assert got["salvaged_after_timeout"] == 8
 
 
 def test_run_json_cmd_timeout_no_output(bench):
@@ -407,3 +407,43 @@ def test_fft_planar_stage_merged_and_compacted(bench, tmp_path):
     assert out["tpu_fft_planar"]["platform"] == "tpu"
     line = bench._compact_line(out)
     assert line["fft_planar"] == {"ok": 2, "total": 3}
+
+
+# --------------------------------------------- batched-throughput race
+def test_batched_row_compacted(bench):
+    """The batched race's serving-throughput stamp (solves_per_sec@K,
+    batch_plan) rides the compact stdout line; a failed race surfaces
+    a truncated error instead of vanishing."""
+    result = {"platform": "cpu", "value": 1.0, "unit": "iters/s",
+              "batched": {"K": 16, "niter": 20,
+                          "solves_per_sec@16": 1500.0,
+                          "sequential_solves_per_sec": 120.0,
+                          "speedup_vs_sequential": 12.5,
+                          "batch_plan": "default"}}
+    line = bench._compact_line(result)
+    assert line["batched"]["solves_per_sec@16"] == 1500.0
+    assert line["batched"]["speedup_vs_sequential"] == 12.5
+    assert line["batched"]["batch_plan"] == "default"
+    assert line["batched"]["K"] == 16
+    bad = dict(result, batched={"error": "x" * 500})
+    line2 = bench._compact_line(bad)
+    assert line2["batched"] == {"error": "x" * 120}
+
+
+def test_batched_row_survives_banked_tpu_headline(bench, tmp_path):
+    """A banked TPU headline replacing the CPU-sim result must not
+    swallow the round's LIVE batched-throughput measurement — same
+    rule as the tuner race."""
+    root = str(tmp_path)
+    _write(root, cache={
+        "flagship_full": {"result": _tpu_result(99.0), "ts": "t"},
+    })
+    live = {"platform": "cpu", "value": 1.0,
+            "batched": {"K": 16, "solves_per_sec@16": 1500.0,
+                        "speedup_vs_sequential": 12.5,
+                        "batch_plan": "default"}}
+    out = bench._merge_tpu_cache(live, root=root)
+    assert out["cached"] and out["platform"] == "tpu"
+    assert out["batched"]["solves_per_sec@16"] == 1500.0
+    line = bench._compact_line(out)
+    assert line["batched"]["solves_per_sec@16"] == 1500.0

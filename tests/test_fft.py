@@ -417,6 +417,7 @@ def test_planar_pencil_hlo_complex_free(rng):
     assert_complex_free(lambda a, b: Rop.rmatvec_planes(a, b), wr, wi)
 
 
+@pytest.mark.slow  # ~13 s compile; the planar CI leg runs it every push
 def test_matvec_planes_matches_complex_matvec(rng, monkeypatch):
     """The plane-aware API computes exactly what the complex-facing
     matvec/rmatvec produce (same planar kernel, minus the boundary

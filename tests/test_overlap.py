@@ -162,6 +162,7 @@ def test_summa_ring_matches_oracle(rng, schedule, N, K, M):
     pmt.dottest(Op, dx, dy)
 
 
+@pytest.mark.slow  # ~10 s compile; the overlap CI leg runs it every push
 def test_summa_ring_complex(rng):
     A = (rng.standard_normal((14, 10))
          + 1j * rng.standard_normal((14, 10)))

@@ -146,9 +146,13 @@ def test_seeded_cache_flips_schedule(rng, monkeypatch):
     from pylops_mpi_tpu.parallel.mesh import default_mesh, best_grid_2d
     mesh = default_mesh()
     grid = best_grid_2d(int(mesh.devices.size))
+    # mirror the operator's consult extras (incl. the serving-width
+    # batch hint — keys gain |b{K} when PYLOPS_MPI_TPU_BATCH>1)
+    from pylops_mpi_tpu.utils.deps import batch_default
     key = tplan.plan_key("matrixmult", (24, 16, 8), np.float64,
                          int(mesh.devices.size),
-                         tuple(mesh.axis_names), {"grid": grid})
+                         tuple(mesh.axis_names),
+                         {"grid": grid, "batch": batch_default()})
     tcache.store(key, {"params": {"schedule": "stat_a",
                                   "overlap": "off"},
                        "provenance": "tuned"})
@@ -175,9 +179,11 @@ def test_env_pin_beats_tuned_plan(rng, monkeypatch):
     from pylops_mpi_tpu.parallel.mesh import default_mesh, best_grid_2d
     mesh = default_mesh()
     grid = best_grid_2d(int(mesh.devices.size))
+    from pylops_mpi_tpu.utils.deps import batch_default
     key = tplan.plan_key("matrixmult", (24, 16, 8), np.float64,
                          int(mesh.devices.size),
-                         tuple(mesh.axis_names), {"grid": grid})
+                         tuple(mesh.axis_names),
+                         {"grid": grid, "batch": batch_default()})
     tcache.store(key, {"params": {"schedule": "gather",
                                   "overlap": "off"}})
     A = rng.standard_normal((24, 16))
@@ -426,6 +432,19 @@ def test_shape_bucketing():
     assert k1 == k2
     assert k1 != tplan.plan_key("matrixmult", (4096, 4096, 64),
                                 np.float32, 4, ("sp",))
+
+
+def test_plan_key_batch_axis():
+    """batch=1 (and absent) keep the historical key — existing caches
+    stay valid; K>1 forks the key with a |b{K} suffix."""
+    base = tplan.plan_key("matrixmult", (64, 64, 8), np.float32, 8,
+                          ("sp",))
+    k1 = tplan.plan_key("matrixmult", (64, 64, 8), np.float32, 8,
+                        ("sp",), {"batch": 1})
+    k16 = tplan.plan_key("matrixmult", (64, 64, 8), np.float32, 8,
+                         ("sp",), {"batch": 16})
+    assert k1 == base
+    assert k16 != base and k16.endswith("|b16")
 
 
 # ----------------------------------------------- resolve_chunks planning
