@@ -1391,12 +1391,152 @@ def _merge_tpu_cache(result, root=None):
     return result
 
 
+# --------------------------------------------------- regression sentinel
+# ISSUE 10: compare a fresh artifact against the banked BENCH_r*.json
+# history so a perf regression fails loudly in CI instead of silently
+# shipping a slower flagship row.  History rows mix platforms and
+# shapes (r02 is an 8-dev CPU run, r04/r05 are 1-dev TPU), so rows are
+# bucketed by (platform, n_devices, nblock) and the fresh value is
+# compared against the MEDIAN of its own bucket — robust to one
+# anomalous round in the bank.
+
+_SENTINEL_FLAG = "--sentinel"
+_SENTINEL_ARTIFACT_FLAG = "--sentinel-artifact"
+_SENTINEL_TOL_FLAG = "--sentinel-tol"
+# module state so _emit_final can stamp the verdict onto the one
+# compact stdout line without threading a parameter through main()
+_SENTINEL_STATE = {"enabled": False, "tolerance": None, "verdict": None}
+
+
+def _sentinel_tolerance(explicit=None):
+    """Relative slowdown tolerated before the sentinel trips: the
+    ``--sentinel-tol`` flag, else ``BENCH_SENTINEL_TOL``, else 0.15
+    (the ISSUE 10 acceptance threshold). Clamped to [0, 1)."""
+    v = explicit
+    if v is None:
+        try:
+            v = float(os.environ.get("BENCH_SENTINEL_TOL", "0.15"))
+        except ValueError:
+            v = 0.15
+    return min(0.999, max(0.0, float(v)))
+
+
+def _load_bench_history(root=None):
+    """Parsed rows from the banked ``BENCH_r*.json`` files next to this
+    script, round order, skipping rounds whose ``parsed`` is null or
+    garbage (r01/r03 in the current bank). Every failure mode is a
+    skipped row, never an exception — the sentinel degrades to
+    ``no-history`` rather than taking the bench down."""
+    import glob
+    root = root or os.path.dirname(os.path.abspath(__file__))
+    rows = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed") if isinstance(doc, dict) else None
+        if not isinstance(parsed, dict):
+            continue
+        v = parsed.get("value")
+        if not isinstance(v, (int, float)) or v <= 0:
+            continue
+        parsed = dict(parsed)
+        parsed["_source"] = os.path.basename(path)
+        rows.append(parsed)
+    return rows
+
+
+def _norm_metric(metric):
+    """Metric string reduced to its measurement identity: per-run
+    numeric annotations (``rel_err=2.6e-07``, ``GEMM GFLOP/s=631``)
+    stripped, punctuation/case collapsed. What remains describes WHAT
+    was measured — e.g. r04's 'cached flagship_small ... promoted to
+    primary' vs r05's '... marginal per-iter timing' are different
+    methodologies at the same (tpu, 1 dev, nblock=1024) topology, 100x
+    apart, and must never share a baseline."""
+    import re
+    m = re.sub(r"[\w ./]+=\s*[-+0-9.eE]+", "", str(metric or ""))
+    return re.sub(r"[^a-z0-9]+", " ", m.lower()).strip()
+
+
+def _sentinel_bucket(row):
+    """Comparability key: rows from different platforms/topologies,
+    flagship shapes or timing methodologies must never be compared (a
+    1-dev TPU round at 150k iters/s would flag every CPU round as a
+    99% regression)."""
+    return (_norm_metric(row.get("metric")), row.get("platform"),
+            row.get("n_devices"), row.get("nblock"))
+
+
+def _sentinel_check(result, history, tolerance=0.15):
+    """Verdict dict for ``result`` against ``history``. ``regressed``
+    is True when the fresh value is below ``median(bucket) x
+    (1 - tolerance)``; an empty bucket is ``status="no-history"`` and
+    never trips (first round on a new topology must pass)."""
+    import statistics
+    bucket = _sentinel_bucket(result)
+    rows = [h for h in history if _sentinel_bucket(h) == bucket]
+    verdict = {
+        "tolerance": tolerance,
+        "bucket": {"metric": bucket[0][:80], "platform": bucket[1],
+                   "n_devices": bucket[2], "nblock": bucket[3]},
+        "n_history": len(rows),
+        "history": [{"source": h.get("_source"), "value": h.get("value")}
+                    for h in rows],
+    }
+    fresh = result.get("value")
+    if not rows:
+        verdict.update(status="no-history", regressed=False)
+        return verdict
+    baseline = statistics.median(h["value"] for h in rows)
+    verdict["baseline"] = round(baseline, 4)
+    if not isinstance(fresh, (int, float)) or fresh <= 0:
+        # a dead/valueless fresh run against real history IS a
+        # regression — this is exactly the failure CI must catch
+        verdict.update(fresh=fresh, status="no-value", regressed=True)
+        return verdict
+    ratio = fresh / baseline
+    regressed = fresh < baseline * (1.0 - tolerance)
+    verdict.update(fresh=round(float(fresh), 4), ratio=round(ratio, 4),
+                   status="regressed" if regressed else "ok",
+                   regressed=regressed)
+    return verdict
+
+
+def _sentinel_artifact_main(path, tolerance):
+    """``--sentinel-artifact PATH``: judge an EXISTING artifact (full
+    ``bench_detail.json`` or one compact line — both carry value/
+    platform/n_devices/nblock at top level) without running the bench.
+    Prints the verdict as the last stdout line; exit 1 on regression.
+    This is the fast path for tests and for re-judging a banked run."""
+    try:
+        with open(path) as f:
+            result = json.load(f)
+    except (OSError, ValueError) as e:
+        print(json.dumps({"sentinel": {"status": "unreadable-artifact",
+                                       "error": repr(e)[:200],
+                                       "regressed": True},
+                          "regressed": True}))
+        return 1
+    verdict = _sentinel_check(result, _load_bench_history(), tolerance)
+    print(json.dumps({"sentinel": verdict,
+                      "regressed": verdict["regressed"]}))
+    return 1 if verdict["regressed"] else 0
+
+
 def _emit_final(result):
     """Write the FULL artifact to ``bench_detail.json`` and print a
     compact (≤2 KB) summary as the LAST stdout line. Round-3 failure
     being fixed: the driver records only a stdout tail, and the full
     JSON (components + probe log + selfcheck) overflowed it, leaving
     ``BENCH_r03.json`` with ``"parsed": null``."""
+    if _SENTINEL_STATE["enabled"]:
+        verdict = _sentinel_check(result, _load_bench_history(),
+                                  _SENTINEL_STATE["tolerance"])
+        _SENTINEL_STATE["verdict"] = verdict
+        result["sentinel"] = verdict
     root = os.path.dirname(os.path.abspath(__file__))
     try:
         with open(os.path.join(root, "bench_detail.json"), "w") as f:
@@ -1520,8 +1660,17 @@ def _compact_line(result):
         compact["probe"] = {"attempts": probe.get("attempts"),
                             "statuses": probe.get("statuses"),
                             "last_ts": probe.get("last_ts")}
+    sv = result.get("sentinel") or {}
+    if sv:
+        # the boolean stamp survives shedding; the detail dict is the
+        # first victim below
+        compact["regressed"] = bool(sv.get("regressed"))
+        compact["sentinel"] = {
+            k: sv.get(k) for k in
+            ("status", "baseline", "fresh", "ratio", "tolerance",
+             "n_history") if sv.get(k) is not None}
     # hard ≤2KB guarantee: shed optional detail, most-expendable first
-    for victim in ("probe", "roofline", "components", "bf16_race",
+    for victim in ("sentinel", "probe", "roofline", "components", "bf16_race",
                    "bf16", "f32", "flagship_1dev_cpu", "tpu_breakdown",
                    "overlap", "fft_planar", "selfcheck"):
         if len(json.dumps(compact)) <= 2000:
@@ -1627,10 +1776,29 @@ def main():
     _emit_final(result)
 
 
+def _argval(argv, flag):
+    """Value following ``flag`` in ``argv`` (None when absent/last)."""
+    try:
+        i = argv.index(flag)
+        return argv[i + 1]
+    except (ValueError, IndexError):
+        return None
+
+
 if __name__ == "__main__":
     if _CHILD_FLAG in sys.argv:
         child_main()  # child may crash; the parent handles it
+    elif _SENTINEL_ARTIFACT_FLAG in sys.argv:
+        _tol = _argval(sys.argv, _SENTINEL_TOL_FLAG)
+        sys.exit(_sentinel_artifact_main(
+            _argval(sys.argv, _SENTINEL_ARTIFACT_FLAG) or "",
+            _sentinel_tolerance(float(_tol) if _tol else None)))
     else:
+        if _SENTINEL_FLAG in sys.argv:
+            _tol = _argval(sys.argv, _SENTINEL_TOL_FLAG)
+            _SENTINEL_STATE["enabled"] = True
+            _SENTINEL_STATE["tolerance"] = _sentinel_tolerance(
+                float(_tol) if _tol else None)
         try:
             main()
         except Exception as e:  # absolute last resort: still emit a line
@@ -1638,4 +1806,5 @@ if __name__ == "__main__":
                 "metric": "CGLS iters/sec (bench driver crashed)",
                 "value": 0.0, "unit": "iters/s", "vs_baseline": 0.0,
                 "degraded": True, "error": repr(e)[:800]}))
-        sys.exit(0)
+        v = _SENTINEL_STATE["verdict"]
+        sys.exit(1 if (v and v.get("regressed")) else 0)

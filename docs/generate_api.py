@@ -94,7 +94,7 @@ PAGES = {
         ("Fault injection (chaos seams)",
          "pylops_mpi_tpu.resilience.faults",
          ["arm", "disarm", "armed", "consume", "fault_signature",
-          "corrupt_plan_cache", "flaky"]),
+          "host_stall", "corrupt_plan_cache", "flaky"]),
     ],
     "local": [
         ("Local (per-shard) operators", "pylops_mpi_tpu.ops.local",
@@ -153,6 +153,17 @@ PAGES = {
          "pylops_mpi_tpu.diagnostics.profiler",
          ["stage_budget", "DeadlineRunner", "profile_capture",
           "profile_dir"]),
+        ("Fleet metrics registry",
+         "pylops_mpi_tpu.diagnostics.metrics",
+         ["metrics_mode", "metrics_enabled", "metrics_file",
+          "metrics_interval", "inc", "set_gauge", "observe", "timer",
+          "snapshot", "clear_metrics", "write_snapshot",
+          "read_snapshot"]),
+        ("Cross-worker trace aggregation",
+         "pylops_mpi_tpu.diagnostics.aggregate",
+         ["load_events", "guess_rank", "collective_entries",
+          "align_offsets", "merge_traces", "critical_path",
+          "discover_trace_files", "aggregate_files"]),
     ],
     "tuning": [
         ("Plan seam", "pylops_mpi_tpu.tuning.plan",

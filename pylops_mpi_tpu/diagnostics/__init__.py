@@ -27,6 +27,20 @@ Four modules make the folklore first-class:
   (``bench.py``, ``benchmarks/tpu_probe_loop.py``,
   ``benchmarks/rehearse_ladder.py``).
 
+Fleet observability (ISSUE 10) adds the cross-process half:
+
+- :mod:`~pylops_mpi_tpu.diagnostics.metrics` — process-wide
+  counters/gauges/histograms (solver iterations, guard verdicts,
+  collective bytes, plan-cache hits, retries, per-stage wall) gated by
+  ``PYLOPS_MPI_TPU_METRICS``, with atomic periodic snapshots and the
+  snapshot embedded in every supervised heartbeat.
+- :mod:`~pylops_mpi_tpu.diagnostics.aggregate` — merges per-worker
+  trace JSONLs into ONE clock-aligned Chrome trace (``pid=rank``),
+  stamping every matched collective with ``skew_us`` +
+  ``straggler_rank`` and computing per-solve critical paths.
+- ``python -m pylops_mpi_tpu.diagnostics`` — the jax-free CLI over
+  both (:mod:`~pylops_mpi_tpu.diagnostics.__main__`).
+
 See ``docs/observability.md`` for the env knobs and artifact schema.
 """
 
@@ -34,6 +48,8 @@ from . import trace
 from . import costmodel
 from . import telemetry
 from . import profiler
+from . import metrics
+from . import aggregate
 
 from .trace import (trace_mode, trace_enabled, span, event, counter,
                     get_events, clear_events, dump, span_tree)
@@ -45,9 +61,19 @@ from .telemetry import (telemetry_enabled, iteration, history,
                         clear_history, telemetry_signature)
 from .profiler import (STAGE_BUDGETS, stage_budget, DeadlineRunner,
                        profile_capture)
+from .metrics import (metrics_mode, metrics_enabled, inc, set_gauge,
+                      observe, timer, snapshot, clear_metrics,
+                      write_snapshot, read_snapshot)
+from .aggregate import (load_events, merge_traces, aggregate_files,
+                        critical_path)
 
 __all__ = [
-    "trace", "costmodel", "telemetry", "profiler",
+    "trace", "costmodel", "telemetry", "profiler", "metrics",
+    "aggregate",
+    "metrics_mode", "metrics_enabled", "inc", "set_gauge", "observe",
+    "timer", "snapshot", "clear_metrics", "write_snapshot",
+    "read_snapshot",
+    "load_events", "merge_traces", "aggregate_files", "critical_path",
     "trace_mode", "trace_enabled", "span", "event", "counter",
     "get_events", "clear_events", "dump", "span_tree",
     "OpCost", "estimate", "register_cost", "roofline",

@@ -1,0 +1,289 @@
+"""Process-wide metrics registry — counters, gauges, histograms.
+
+The fleet-observability companion to :mod:`.trace` (ISSUE 10): spans
+answer "what happened, when" for ONE process; this module answers "how
+much, so far" in a form a supervisor can poll while the worker is
+still alive. The registry holds the numbers every prior subsystem
+already computes but only logs transiently:
+
+- solver iterations / solves / restarts (``solvers/basic.py``,
+  ``solvers/block.py``, ``resilience/driver.py``),
+- guard verdicts per status kind (``resilience/status.py``),
+- collective calls and byte estimates per op
+  (``parallel/collectives.py``),
+- tuning plan-cache hits/misses (``tuning/cache.py``),
+- bounded-retry counts (``resilience/retry.py``),
+- per-stage wall clocks (the :func:`timer` handle around the solver
+  entry points).
+
+Gating — ``PYLOPS_MPI_TPU_METRICS``:
+
+- ``off`` (default): every entry point returns after ONE env dict
+  lookup; nothing is allocated, no thread is started. The registry is
+  pure host-side Python and never touches jax, so compiled programs
+  are BIT-IDENTICAL in both modes (pinned in
+  ``tests/test_fleet_obs.py`` via ``utils/hlo.py``) — unlike
+  ``TRACE=full`` telemetry, metrics-on adds zero in-loop host
+  callbacks because every increment happens AFTER the fused loop
+  returns to Python.
+- ``on``: increments are recorded (one lock + dict op each). Unknown
+  values warn once and stay off — same rule as the trace/guard knobs.
+
+Snapshots: :func:`snapshot` returns the registry as one JSON-safe
+dict. When ``PYLOPS_MPI_TPU_METRICS_FILE`` is set, a daemon thread
+(started lazily at the first recorded metric) writes the snapshot
+there every ``PYLOPS_MPI_TPU_METRICS_INTERVAL`` seconds, atomically
+(pid-suffixed temp + ``os.replace``, the heartbeat/plan-cache idiom)
+with a final write at exit — a killed worker leaves its last-written
+numbers behind. Supervised workers additionally embed the snapshot in
+every heartbeat (``resilience/elastic.py``), so the supervisor sees
+live per-worker PROGRESS, not just liveness, and
+:func:`~pylops_mpi_tpu.resilience.supervisor.launch_job` harvests the
+final snapshots into ``JobResult.metrics`` / ``job_report.json``.
+
+This module is deliberately stdlib-only and standalone-loadable (like
+:mod:`.profiler`): the supervisor process, which never imports jax,
+reads and embeds snapshots through it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["metrics_mode", "metrics_enabled", "metrics_file",
+           "metrics_interval", "inc", "set_gauge", "observe", "timer",
+           "snapshot", "clear_metrics", "write_snapshot",
+           "read_snapshot", "SNAPSHOT_SCHEMA"]
+
+SNAPSHOT_SCHEMA = 1
+
+_MODES = ("off", "on")
+_warned_mode = False
+
+
+def metrics_mode() -> str:
+    """``PYLOPS_MPI_TPU_METRICS`` resolved to ``off``/``on`` (default
+    ``off``; ``1``/``true`` count as ``on``; unknown values warn once
+    and stay off — a typo in a CI matrix must not silently flip the
+    registry on). Read per call so tests and long-lived sessions can
+    flip the env without a cache to reset."""
+    global _warned_mode
+    m = os.environ.get("PYLOPS_MPI_TPU_METRICS", "off").strip().lower()
+    if m in ("", "0", "none", "default", "false"):
+        m = "off"
+    if m in ("1", "true"):
+        m = "on"
+    if m not in _MODES:
+        if not _warned_mode:
+            import warnings
+            warnings.warn(
+                f"PYLOPS_MPI_TPU_METRICS={m!r} is not one of {_MODES}; "
+                "metrics stay off", stacklevel=2)
+            _warned_mode = True
+        m = "off"
+    return m
+
+
+def metrics_enabled() -> bool:
+    return metrics_mode() == "on"
+
+
+def metrics_file() -> Optional[str]:
+    """``PYLOPS_MPI_TPU_METRICS_FILE`` — the periodic-snapshot path
+    (assigned per worker by the supervisor), or ``None``."""
+    return os.environ.get("PYLOPS_MPI_TPU_METRICS_FILE") or None
+
+
+def metrics_interval() -> float:
+    """``PYLOPS_MPI_TPU_METRICS_INTERVAL`` snapshot-write interval in
+    seconds (default 5.0; floored at 0.05 so a typo cannot busy-spin
+    the writer — the heartbeat rule)."""
+    try:
+        v = float(os.environ.get("PYLOPS_MPI_TPU_METRICS_INTERVAL",
+                                 "5.0"))
+    except ValueError:
+        v = 5.0
+    return max(0.05, v)
+
+
+_LOCK = threading.Lock()
+_COUNTERS: Dict[str, float] = {}
+_GAUGES: Dict[str, float] = {}
+# histogram summaries, not buckets: the consumers (heartbeat payload,
+# job_report.json) need "how long / how many, roughly", and a fixed
+# 5-number summary keeps every beat O(registry size), never O(samples)
+_HISTS: Dict[str, Dict[str, float]] = {}
+
+
+def inc(name: str, value: float = 1) -> None:
+    """Add ``value`` to counter ``name``. No-op (one env lookup) when
+    metrics are off."""
+    if metrics_mode() == "off":
+        return
+    with _LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + value
+    _maybe_start_writer()
+
+
+def set_gauge(name: str, value: float) -> None:
+    """Set gauge ``name`` to its latest ``value`` (last-write-wins)."""
+    if metrics_mode() == "off":
+        return
+    with _LOCK:
+        _GAUGES[name] = value
+    _maybe_start_writer()
+
+
+def observe(name: str, value: float) -> None:
+    """Record one sample into histogram ``name`` (count/sum/min/max/
+    last summary)."""
+    if metrics_mode() == "off":
+        return
+    value = float(value)
+    with _LOCK:
+        h = _HISTS.get(name)
+        if h is None:
+            _HISTS[name] = {"count": 1, "sum": value, "min": value,
+                            "max": value, "last": value}
+        else:
+            h["count"] += 1
+            h["sum"] += value
+            h["min"] = min(h["min"], value)
+            h["max"] = max(h["max"], value)
+            h["last"] = value
+    _maybe_start_writer()
+
+
+class _Timer:
+    __slots__ = ("name", "t0")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.t0 = 0.0
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        observe(self.name + ".wall_s", time.perf_counter() - self.t0)
+        return False
+
+
+class _NoopTimer:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_TIMER = _NoopTimer()
+
+
+def timer(name: str):
+    """Context manager observing the block's wall time into histogram
+    ``<name>.wall_s`` — the per-stage wall metric around the solver
+    entry points. Shared no-op when metrics are off."""
+    if metrics_mode() == "off":
+        return _NOOP_TIMER
+    return _Timer(name)
+
+
+def snapshot() -> Dict:
+    """The registry as one JSON-safe dict:
+    ``{"schema", "pid", "wall", "counters", "gauges", "histograms"}``.
+    Cheap (one lock, shallow copies) — safe to embed in every
+    heartbeat."""
+    with _LOCK:
+        return {"schema": SNAPSHOT_SCHEMA, "pid": os.getpid(),
+                "wall": time.time(),
+                "counters": dict(_COUNTERS),
+                "gauges": dict(_GAUGES),
+                "histograms": {k: dict(v) for k, v in _HISTS.items()}}
+
+
+def clear_metrics() -> None:
+    """Drop every recorded value (test-isolation helper). The snapshot
+    writer thread, once started, stays running — it will just write
+    empty registries."""
+    with _LOCK:
+        _COUNTERS.clear()
+        _GAUGES.clear()
+        _HISTS.clear()
+
+
+# ------------------------------------------------- snapshot persistence
+def write_snapshot(path: Optional[str] = None) -> Optional[str]:
+    """Write :func:`snapshot` to ``path`` (default:
+    :func:`metrics_file`) atomically — pid-suffixed temp +
+    ``os.replace``, so a reader can never observe a torn snapshot.
+    Returns the path written, or ``None`` when no path is configured.
+    A failed write is swallowed: persistence must never take the
+    workload down (the heartbeat/plan-cache rule)."""
+    path = path or metrics_file()
+    if not path:
+        return None
+    path = os.path.abspath(path)
+    tmp = path + f".tmp{os.getpid()}"
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(tmp, "w") as f:
+            json.dump(snapshot(), f)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def read_snapshot(path: str) -> Optional[Dict]:
+    """Parse a snapshot file: the dict, or ``None`` when missing /
+    (transiently) unparseable / not a snapshot — the supervisor-side
+    reader, so every failure mode is a quiet miss."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or "counters" not in doc:
+        return None
+    return doc
+
+
+_WRITER_LOCK = threading.Lock()
+_writer_started = False
+
+
+def _maybe_start_writer() -> None:
+    """Start the periodic snapshot-writer daemon thread once, iff a
+    snapshot file is configured. Called from every record path with a
+    plain-bool fast exit, so steady-state cost is one attribute read."""
+    global _writer_started
+    if _writer_started or not metrics_file():
+        return
+    with _WRITER_LOCK:
+        if _writer_started:
+            return
+        _writer_started = True
+        import atexit
+        atexit.register(write_snapshot)
+
+        def loop():
+            while True:
+                time.sleep(metrics_interval())
+                write_snapshot()
+
+        threading.Thread(target=loop, daemon=True,
+                         name="pylops-metrics").start()
+    write_snapshot()  # first snapshot immediately, like the first beat

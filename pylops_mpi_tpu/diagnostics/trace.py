@@ -393,21 +393,42 @@ def span_tree(events: Optional[List[Dict]] = None) -> List[Dict]:
     events carry explicit ``depth``; reconstruction scans per-thread in
     END-time order (a parent's event is recorded after its
     children's), pushing each span under the most recent deeper-or-
-    equal-depth run."""
+    equal-depth run.
+
+    Hardened for POST-MORTEM artifacts (ISSUE 10): the input may be a
+    killed worker's flush, so non-dict entries, events with missing or
+    mistyped fields, and unclosed ``ph="B"`` spans must all degrade
+    gracefully instead of raising. ``B`` events (one still-open
+    ancestry chain per thread) become nodes with ``dur=None``; spans
+    whose parent never closed are adopted under the deepest open span
+    shallower than them."""
     if events is None:
         events = get_events()
     roots: List[Dict] = []
     by_tid: Dict = {}
     for ev in events:
-        if ev.get("ph") != "X":
+        if not isinstance(ev, dict) or ev.get("ph") not in ("X", "B"):
             continue
+        if not isinstance(ev.get("name"), str) \
+                or not isinstance(ev.get("ts"), (int, float)):
+            continue  # a garbage line must not crash the post-mortem
         by_tid.setdefault(ev.get("tid"), []).append(ev)
     for tid_events in by_tid.values():
         stack: List = []  # (depth, node) of spans awaiting a parent
+        open_chain: List = []  # (depth, node) of ph="B" open spans
         for ev in tid_events:  # buffer order == end-time order
-            depth = (ev.get("args") or {}).get("depth", 0)
-            node = {"name": ev["name"], "ts": ev["ts"], "dur": ev["dur"],
-                    "args": ev.get("args", {}), "children": []}
+            args = ev.get("args") if isinstance(ev.get("args"),
+                                                dict) else {}
+            depth = args.get("depth", 0)
+            if not isinstance(depth, int) or depth < 0:
+                depth = 0
+            dur = ev.get("dur")
+            node = {"name": ev["name"], "ts": ev["ts"],
+                    "dur": dur if isinstance(dur, (int, float)) else None,
+                    "args": args, "children": []}
+            if ev.get("ph") == "B":
+                open_chain.append((depth, node))
+                continue
             while stack and stack[-1][0] > depth:
                 node["children"].append(stack.pop()[1])
             node["children"].reverse()  # recorded youngest-first
@@ -415,6 +436,23 @@ def span_tree(events: Optional[List[Dict]] = None) -> List[Dict]:
                 roots.append(node)
             else:
                 stack.append((depth, node))
+        if open_chain:
+            # the open spans of one thread form a single ancestry
+            # chain (outermost first after the depth sort); completed
+            # spans still awaiting a parent were inside the deepest
+            # open span shallower than them
+            open_chain.sort(key=lambda p: p[0])
+            for i in range(len(open_chain) - 1):
+                open_chain[i][1]["children"].append(open_chain[i + 1][1])
+            for d, n in stack:
+                host = None
+                for bd, bn in open_chain:
+                    if bd < d:
+                        host = bn
+                (host["children"].append(n) if host is not None
+                 else roots.append(n))
+            stack = []
+            roots.append(open_chain[0][1])
         # orphans (parent span still open at snapshot time)
         roots.extend(n for _, n in stack)
     roots.sort(key=lambda n: n["ts"])

@@ -44,7 +44,8 @@ call sites that need them (``DistributedArray._reduce``).
 from __future__ import annotations
 
 import logging
-from typing import Callable, Optional, Sequence
+import threading
+from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
 import jax
@@ -52,6 +53,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from ..jaxcompat import shard_map
+from ..diagnostics import metrics as _metrics
 from ..diagnostics import trace as _trace
 
 __all__ = [
@@ -68,6 +70,46 @@ __all__ = [
 ]
 
 _logger = logging.getLogger("pylops_mpi_tpu.collectives")
+
+# ---------------------------------------------- per-op sequence numbers
+# Every rank of an SPMD job reaches the collectives in the same
+# deterministic program order, so a per-op-name call counter gives the
+# cross-rank matching key the fleet aggregator needs: span (name, seq)
+# on rank 0 is THE SAME collective as (name, seq) on rank 7
+# (diagnostics/aggregate.py stamps skew_us/straggler_rank per match).
+# Incremented unconditionally — flipping TRACE mid-run must not
+# desynchronize the counters across ranks — but these wrappers run
+# per *dispatch* (often once per compile), never per device step, so
+# the cost is one lock + dict op off the hot path.
+_SEQ_LOCK = threading.Lock()
+_SEQ: Dict[str, int] = {}
+
+
+def _collective_seq(name: str) -> int:
+    with _SEQ_LOCK:
+        n = _SEQ.get(name, 0)
+        _SEQ[name] = n + 1
+    return n
+
+
+def _count_collective(name: str, nbytes: Optional[int] = None) -> int:
+    """Metrics + sequencing for one collective dispatch: bumps the
+    per-op call (and, when an estimate exists, byte) counters in the
+    metrics registry and returns this call's sequence number for the
+    span tags."""
+    _metrics.inc(f"collective.{name}.calls")
+    if nbytes is not None:
+        _metrics.inc(f"collective.{name}.bytes", int(nbytes))
+    return _collective_seq(name)
+
+
+def _est_bytes(x, scale: float = 1.0) -> Optional[int]:
+    """Best-effort payload estimate for an array (works on tracers —
+    shapes are static); ``None`` when the array doesn't expose one."""
+    try:
+        return int(x.size * x.dtype.itemsize * scale)
+    except (AttributeError, TypeError):
+        return None
 
 
 def all_to_all_resharding(x: jax.Array, mesh: Mesh,
@@ -104,11 +146,13 @@ def all_to_all_resharding(x: jax.Array, mesh: Mesh,
         return lax.all_to_all(xs, axis_name, split_axis=new_axis,
                               concat_axis=old_axis, tiled=True)
 
+    ici_bytes = int(x.size * x.dtype.itemsize
+                    * (n_dev - 1) / max(n_dev, 1))
     with _trace.span("collective.all_to_all_resharding", cat="collective",
                      shape=x.shape, dtype=x.dtype, old_axis=old_axis,
-                     new_axis=new_axis, n_dev=n_dev,
-                     ici_bytes=int(x.size * x.dtype.itemsize
-                                   * (n_dev - 1) / max(n_dev, 1))):
+                     new_axis=new_axis, n_dev=n_dev, ici_bytes=ici_bytes,
+                     seq=_count_collective("all_to_all_resharding",
+                                           ici_bytes)):
         return shard_map(kernel, mesh=mesh, in_specs=P(*in_spec),
                          out_specs=P(*out_spec))(x)
 
@@ -135,7 +179,9 @@ def plane_all_to_all(br: jax.Array, bi: jax.Array, axis_name: str, *,
     with _trace.span("collective.plane_all_to_all", cat="collective",
                      shape=br.shape, dtype=br.dtype,
                      split_axis=split_axis, concat_axis=concat_axis,
-                     axis=axis_name):
+                     axis=axis_name,
+                     seq=_count_collective("plane_all_to_all",
+                                           _est_bytes(br, 2.0))):
         s = jnp.stack([br, bi], axis=-1)
         s = lax.all_to_all(s, axis_name, split_axis=split_axis,
                            concat_axis=concat_axis, tiled=True)
@@ -177,7 +223,8 @@ def cart_halo_extend(block: jax.Array, axis_name: str,
     _trace.event("collective.cart_halo_extend", cat="collective",
                  shape=getattr(block, "shape", None),
                  dtype=getattr(block, "dtype", None), axis=axis_name,
-                 grid=tuple(int(g) for g in grid), ax=ax, hm=hm, hp=hp)
+                 grid=tuple(int(g) for g in grid), ax=ax, hm=hm, hp=hp,
+                 seq=_count_collective("cart_halo_extend"))
     if g_ax == 1:
         padw = [(0, 0)] * block.ndim
         padw[a_ax] = (hm, hp)
@@ -260,7 +307,9 @@ def ring_pass(block, axis_name: str, n_shards: int, body: Callable,
     with _trace.span("collective.ring_pass", cat="collective",
                      shape=getattr(block, "shape", None),
                      dtype=getattr(block, "dtype", None), axis=axis_name,
-                     n_shards=n, shift=shift, hops=n - 1):
+                     n_shards=n, shift=shift, hops=n - 1,
+                     seq=_count_collective(
+                         "ring_pass", _est_bytes(block, n - 1))):
         i = lax.axis_index(axis_name)
         perm = [(r, (r - shift) % n) for r in range(n)]
         acc = init
@@ -293,7 +342,8 @@ def ring_halo_ghosts(block, axis_name: str, n_shards: int,
     with _trace.span("collective.ring_halo_ghosts", cat="collective",
                      shape=getattr(block, "shape", None),
                      dtype=getattr(block, "dtype", None), axis=axis_name,
-                     n_shards=n, front=front, back=back, ax=ax):
+                     n_shards=n, front=front, back=back, ax=ax,
+                     seq=_count_collective("ring_halo_ghosts")):
         gf = gb = None
         if front:
             start = jnp.maximum(valid_len - front, 0)
@@ -381,7 +431,9 @@ def chunked_pencil_transpose(b, axis_name: str, n_shards: int,
                      cat="collective", shape=b.shape, dtype=b.dtype,
                      axis=axis_name, n_shards=int(n_shards),
                      out_ax=out_ax, chunks=K,
-                     a2a_per_transpose=K * (2 if n_shards > 1 else 0)):
+                     a2a_per_transpose=K * (2 if n_shards > 1 else 0),
+                     seq=_count_collective("chunked_pencil_transpose",
+                                           _est_bytes(b, 2.0))):
         b = _pad_axis_to(b, out_ax, tile * bo)
         cw = n_shards * bo  # chunk width, divisible by the mesh size
         outs = []
@@ -411,7 +463,10 @@ def chunked_pencil_transpose_planes(br, bi, axis_name: str,
     with _trace.span("collective.chunked_pencil_transpose_planes",
                      cat="collective", shape=br.shape, dtype=br.dtype,
                      axis=axis_name, n_shards=int(n_shards),
-                     out_ax=out_ax, chunks=K, planar=True):
+                     out_ax=out_ax, chunks=K, planar=True,
+                     seq=_count_collective(
+                         "chunked_pencil_transpose_planes",
+                         _est_bytes(br, 4.0))):
         br = _pad_axis_to(br, out_ax, tile * bo)
         bi = _pad_axis_to(bi, out_ax, tile * bo)
         cw = n_shards * bo

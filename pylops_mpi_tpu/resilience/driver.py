@@ -36,6 +36,7 @@ from typing import Callable, Optional, Sequence, Union
 
 import numpy as np
 
+from ..diagnostics import metrics as _metrics
 from ..diagnostics import trace as _trace
 from . import status as _rstatus
 
@@ -134,6 +135,7 @@ def resilient_solve(make_op: Union[Callable, object], y, x0=None, *,
         if factory is None or nxt is None or restarts >= max_restarts:
             break
         restarts += 1
+        _metrics.inc(f"solver.{solver}.restarts")
         _trace.event("solver.restart", cat="resilience", solver=solver,
                      status=_rstatus.status_name(code),
                      at_iter=total_iiter, restart=restarts,

@@ -55,6 +55,7 @@ import time
 from collections import namedtuple
 from typing import Any, Callable, Dict, Optional
 
+from ..diagnostics import metrics as _metrics
 from ..diagnostics import trace as _trace
 from ..diagnostics.profiler import STAGE_BUDGETS
 
@@ -83,10 +84,13 @@ def heartbeat_file() -> Optional[str]:
 
 
 class HeartbeatWriter(threading.Thread):
-    """Daemon thread writing ``{"pid", "seq", "wall", "mono"}`` to
-    ``path`` every ``interval`` seconds, atomically (pid-suffixed temp
-    + ``os.replace``), so the supervisor's reader can never observe a
-    torn beat. ``stop()`` is idempotent and joins the thread."""
+    """Daemon thread writing ``{"pid", "seq", "wall", "mono"}`` —
+    plus ``"metrics"`` (the live registry snapshot,
+    ``diagnostics/metrics.py``) when ``PYLOPS_MPI_TPU_METRICS=on`` —
+    to ``path`` every ``interval`` seconds, atomically (pid-suffixed
+    temp + ``os.replace``), so the supervisor's reader can never
+    observe a torn beat. ``stop()`` is idempotent and joins the
+    thread."""
 
     def __init__(self, path: str, interval: float):
         super().__init__(name="pylops-heartbeat", daemon=True)
@@ -98,9 +102,17 @@ class HeartbeatWriter(threading.Thread):
 
     def beat(self) -> None:
         self.seq += 1
-        payload = json.dumps({"pid": os.getpid(), "seq": self.seq,
-                              "wall": time.time(),
-                              "mono": time.monotonic()})
+        doc = {"pid": os.getpid(), "seq": self.seq,
+               "wall": time.time(), "mono": time.monotonic()}
+        # live per-worker PROGRESS, not just liveness (ISSUE 10): the
+        # supervisor's read_heartbeat sees the current metrics registry
+        # in every beat. One env lookup when metrics are off.
+        if _metrics.metrics_enabled():
+            try:
+                doc["metrics"] = _metrics.snapshot()
+            except Exception:
+                pass  # a metrics bug must not kill the beat
+        payload = json.dumps(doc)
         tmp = self.path + f".tmp{os.getpid()}"
         try:
             with open(tmp, "w") as f:

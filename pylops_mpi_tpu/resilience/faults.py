@@ -40,7 +40,8 @@ import threading
 from typing import Callable, Dict, Optional
 
 __all__ = ["arm", "disarm", "armed", "consume", "fault_signature",
-           "inject_nan", "inject_stall", "corrupt_plan_cache", "flaky"]
+           "inject_nan", "inject_stall", "host_stall",
+           "corrupt_plan_cache", "flaky"]
 
 _LOCK = threading.Lock()
 _ARMED: Optional[Dict] = None
@@ -127,6 +128,18 @@ def inject_stall(a, iiter, at: int):
 
 
 # -------------------------------------------------- host-side chaos
+def host_stall(seconds: float) -> None:
+    """Block THIS process for ``seconds`` — the straggler injection of
+    the fleet-observability acceptance (ISSUE 10): one rank sleeps
+    between collective dispatches so the cross-worker trace aggregation
+    (``diagnostics/aggregate.py``) must attribute the resulting
+    per-collective skew to it. Distinct from ``kind="stall"``
+    (in-loop step zeroing, which burns iterations, not wall clock):
+    collective-entry skew measures wall clock."""
+    import time
+    time.sleep(max(0.0, float(seconds)))
+
+
 def corrupt_plan_cache(path: str, mode: str = "truncate") -> None:
     """Damage a tuning-cache JSON the way a killed writer or a bad
     disk would: ``truncate`` cuts the file mid-object, ``garbage``

@@ -42,6 +42,7 @@ import random
 import time
 from typing import Callable, Optional, Tuple, Type
 
+from ..diagnostics import metrics as _metrics
 from ..diagnostics import trace as _trace
 
 __all__ = ["retry_call", "default_retries", "default_backoff_s",
@@ -120,6 +121,7 @@ def retry_call(fn: Callable, *args,
             if jitter > 0.0 and wait > 0.0:
                 u = (rng or random).random()
                 wait *= 1.0 - jitter * u
+            _metrics.inc("resilience.retries")
             _trace.event("resilience.retry", cat="resilience",
                          what=describe, attempt=attempt,
                          retries=retries, backoff_s=round(wait, 3),

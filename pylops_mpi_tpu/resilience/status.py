@@ -43,6 +43,7 @@ import os
 import threading
 from typing import Dict, Optional
 
+from ..diagnostics import metrics as _metrics
 from ..diagnostics import trace as _trace
 
 __all__ = ["RUNNING", "CONVERGED", "MAXITER", "BREAKDOWN", "STAGNATION",
@@ -137,6 +138,8 @@ def record(solver: str, code: int, iiter: int) -> None:
             "iiter": int(iiter)}
     with _LOCK:
         _LAST[solver] = info
+    # fleet metrics: guard verdicts per kind (ISSUE 10)
+    _metrics.inc(f"guards.{solver}.{status_name(code)}")
     _trace.event("solver.status", cat="resilience", solver=solver, **info)
 
 
@@ -153,6 +156,8 @@ def record_columns(solver: str, codes, iiter: int) -> None:
             "column_names": [status_name(c) for c in codes]}
     with _LOCK:
         _LAST[solver] = info
+    for c in codes:  # per-COLUMN verdicts: K columns, K counts
+        _metrics.inc(f"guards.{solver}.{status_name(c)}")
     _trace.event("solver.status", cat="resilience", solver=solver, **info)
 
 

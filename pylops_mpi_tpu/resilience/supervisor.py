@@ -40,6 +40,7 @@ the tail of each log is collected into the result for post-mortems.
 
 from __future__ import annotations
 
+import json
 import os
 import signal
 import socket
@@ -50,11 +51,14 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..diagnostics import metrics as _metrics
 from ..diagnostics import trace as _trace
 from .elastic import read_heartbeat
 
-__all__ = ["WorkerHandle", "Failure", "JobResult", "free_port",
-           "launch_job"]
+__all__ = ["WorkerHandle", "Failure", "JobResult", "JOB_REPORT_SCHEMA",
+           "free_port", "launch_job", "write_job_report"]
+
+JOB_REPORT_SCHEMA = 1
 
 
 def free_port() -> int:
@@ -75,6 +79,7 @@ class WorkerHandle:
     heartbeat_path: str
     log_path: str
     launched_at: float        # monotonic; bring-up grace reference
+    metrics_path: str = ""    # worker's assigned snapshot file
 
     def alive(self) -> bool:
         return self.proc.poll() is None
@@ -102,8 +107,11 @@ class Failure:
 class JobResult:
     """What :func:`launch_job` hands back: whether the final attempt
     finished clean, how many processes that attempt ran with, every
-    classified failure along the way, and the tail of each final
-    worker's log (keyed by rank)."""
+    classified failure along the way, the tail of each final worker's
+    log (keyed by rank), and — when the workers ran with
+    ``PYLOPS_MPI_TPU_METRICS=on`` — each final worker's last metrics
+    snapshot (``metrics``, keyed by rank; harvested from the worker's
+    snapshot file with its last heartbeat as fallback)."""
     ok: bool
     world_size: int
     attempts: int
@@ -111,6 +119,57 @@ class JobResult:
     outputs: Dict[int, str] = field(default_factory=dict)
     returncodes: Dict[int, int] = field(default_factory=dict)
     logdir: Optional[str] = None
+    metrics: Dict[int, Dict] = field(default_factory=dict)
+
+
+def _harvest_metrics(workers: Sequence[WorkerHandle]) -> Dict[int, Dict]:
+    """Final per-worker metrics snapshots: the worker's snapshot file
+    first (the atexit write is the freshest), its last heartbeat's
+    embedded ``metrics`` payload as fallback (a SIGKILLed worker never
+    ran atexit, but its beats carried the registry). Workers without
+    either (metrics off) are simply absent."""
+    out: Dict[int, Dict] = {}
+    for w in workers:
+        snap = _metrics.read_snapshot(w.metrics_path) \
+            if w.metrics_path else None
+        if snap is None:
+            beat = read_heartbeat(w.heartbeat_path)
+            if beat and isinstance(beat.get("metrics"), dict):
+                snap = beat["metrics"]
+        if snap is not None:
+            out[w.rank] = snap
+    return out
+
+
+def write_job_report(result: JobResult) -> Optional[str]:
+    """Persist the job post-mortem as ``job_report.json`` next to the
+    worker logs (ISSUE 10 log hygiene): schema-versioned, with every
+    failure classification and the final per-worker metrics snapshots.
+    Atomic (temp + ``os.replace``); a failed write is swallowed — the
+    in-memory :class:`JobResult` is already in the caller's hands."""
+    if not result.logdir:
+        return None
+    path = os.path.join(result.logdir, "job_report.json")
+    doc = {"schema": JOB_REPORT_SCHEMA, "ok": result.ok,
+           "world_size": result.world_size, "attempts": result.attempts,
+           "failures": [f.as_dict() for f in result.failures],
+           "returncodes": {str(r): rc
+                           for r, rc in result.returncodes.items()},
+           "metrics": {str(r): m for r, m in result.metrics.items()},
+           "logdir": result.logdir}
+    tmp = path + f".tmp{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+        os.replace(tmp, path)
+        return path
+    except OSError:
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:
+            pass
+        return None
 
 
 def _format_argv(argv: Sequence[str], *, port: int, rank: int,
@@ -270,6 +329,8 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
                               f"worker{slot}.attempt{attempt}.hb")
             log = os.path.join(logdir,
                                f"worker{slot}.attempt{attempt}.log")
+            met = os.path.join(
+                logdir, f"worker{slot}.attempt{attempt}.metrics.json")
             wenv = dict(os.environ)
             if env:
                 wenv.update(env)
@@ -280,6 +341,9 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
                 "PYLOPS_MPI_TPU_ATTEMPT": str(attempt),
                 "PYLOPS_MPI_TPU_HEARTBEAT_FILE": hb,
                 "PYLOPS_MPI_TPU_HEARTBEAT": repr(heartbeat_interval),
+                # snapshot assignment is unconditional: the worker's
+                # registry only starts its writer under METRICS=on
+                "PYLOPS_MPI_TPU_METRICS_FILE": met,
             })
             # relaunched peers must not re-dial the coordinator in
             # lockstep; setdefault so an explicit caller value wins
@@ -297,7 +361,8 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
                 logf.close()  # the child holds its own fd now
             workers.append(WorkerHandle(rank=rank, slot=slot, proc=proc,
                                         heartbeat_path=hb, log_path=log,
-                                        launched_at=time.monotonic()))
+                                        launched_at=time.monotonic(),
+                                        metrics_path=met))
 
         failure: Optional[Failure] = None
         while True:
@@ -312,8 +377,10 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
                 result.failures.append(failure)
                 result.outputs = {w.rank: _tail(w.log_path)
                                   for w in workers}
+                result.metrics = _harvest_metrics(workers)
                 _trace.event("supervisor.timeout", cat="resilience",
                              attempt=attempt)
+                write_job_report(result)
                 return result  # a job timeout is terminal, no relaunch
             if on_poll is not None:
                 on_poll(attempt, workers)
@@ -336,8 +403,10 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
                 result.outputs = {w.rank: _tail(w.log_path)
                                   for w in workers}
                 result.returncodes = {w.rank: 0 for w in workers}
+                result.metrics = _harvest_metrics(workers)
                 _trace.event("supervisor.success", cat="resilience",
                              attempt=attempt, world=world)
+                write_job_report(result)
                 return result
             time.sleep(poll_s)
 
@@ -347,6 +416,7 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
                      **failure.as_dict())
         _kill_all(workers)
         result.outputs = {w.rank: _tail(w.log_path) for w in workers}
+        result.metrics = _harvest_metrics(workers)
         result.returncodes = {w.rank: (w.proc.poll()
                                        if w.proc.poll() is not None
                                        else -9)
@@ -354,8 +424,10 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
         if shrink and failure.slot in slots:
             slots = [s for s in slots if s != failure.slot]
         if not slots or attempt >= max_relaunches:
+            write_job_report(result)
             return result
         _trace.event("supervisor.relaunch", cat="resilience",
                      attempt=attempt + 1, world=len(slots),
                      slots=list(slots))
+    write_job_report(result)
     return result

@@ -35,6 +35,7 @@ from jax import lax
 
 from ..distributedarray import DistributedArray
 from ..stacked import StackedDistributedArray
+from ..diagnostics import metrics as _metrics
 from ..diagnostics import telemetry, trace as _trace
 
 __all__ = ["CG", "CGLS", "cg", "cgls", "cg_guarded", "cgls_guarded",
@@ -758,12 +759,18 @@ def _run_cg_fused(Op, y: Vector, x0: Vector, x0_owned: bool, niter: int,
             y, x0 if x0_owned else _donate_copy(x0), tol)
         iiter, code = int(iiter), int(status)
         _rstatus.record("cg", code, iiter)
+        _metrics.inc("solver.cg.solves")
+        _metrics.inc("solver.cg.iterations", iiter)
         return x, iiter, np.asarray(cost)[:iiter + 1], code
     fn = _get_fused(Op, (id(Op), "cg", niter, _vkey(y), _vkey(x0)),
                     lambda op: partial(_cg_fused, op, niter=niter),
                     donate_argnums=_DONATE_X0)
     x, iiter, cost = fn(y, x0 if x0_owned else _donate_copy(x0), tol)
     iiter = int(iiter)
+    # host-side, AFTER the fused loop returned: metrics never add an
+    # in-loop callback (the fleet-obs HLO pin)
+    _metrics.inc("solver.cg.solves")
+    _metrics.inc("solver.cg.iterations", iiter)
     return x, iiter, np.asarray(cost)[:iiter + 1], None
 
 
@@ -789,7 +796,8 @@ def cg(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
     with _trace.span("solver.cg", cat="solver", op=type(Op).__name__,
                      shape=Op.shape, dtype=_vdtype(x0), niter=niter,
                      tol=tol, fused=use_fused, guards=use_guards,
-                     telemetry=telemetry.telemetry_enabled()):
+                     telemetry=telemetry.telemetry_enabled()), \
+            _metrics.timer("solver.cg"):
         if use_fused:
             x, iiter, cost, _ = _run_cg_fused(Op, y, x0, x0_owned,
                                               niter, tol, use_guards)
@@ -814,7 +822,8 @@ def cg_guarded(Op, y: Vector, x0: Optional[Vector] = None,
     with _trace.span("solver.cg", cat="solver", op=type(Op).__name__,
                      shape=Op.shape, dtype=_vdtype(x0), niter=niter,
                      tol=tol, fused=True, guards=True,
-                     telemetry=telemetry.telemetry_enabled()):
+                     telemetry=telemetry.telemetry_enabled()), \
+            _metrics.timer("solver.cg"):
         return _run_cg_fused(Op, y, x0, x0_owned, niter, tol, True)
 
 
@@ -841,6 +850,8 @@ def _run_cgls_fused(Op, y: Vector, x0: Vector, x0_owned: bool,
             y, x0 if x0_owned else _donate_copy(x0), damp, tol)
         iiter, code = int(iiter), int(status)
         _rstatus.record("cgls", code, iiter)
+        _metrics.inc("solver.cgls.solves")
+        _metrics.inc("solver.cgls.iterations", iiter)
         return (x, iiter, np.asarray(cost)[:iiter + 1],
                 np.asarray(cost1)[:iiter + 1], kold, code)
     fn = _get_fused(Op, (id(Op), "cgls", use_normal, niter,
@@ -850,6 +861,8 @@ def _run_cgls_fused(Op, y: Vector, x0: Vector, x0_owned: bool,
     x, iiter, cost, cost1, kold = fn(
         y, x0 if x0_owned else _donate_copy(x0), damp, tol)
     iiter = int(iiter)
+    _metrics.inc("solver.cgls.solves")
+    _metrics.inc("solver.cgls.iterations", iiter)
     return (x, iiter, np.asarray(cost)[:iiter + 1],
             np.asarray(cost1)[:iiter + 1], kold, None)
 
@@ -885,7 +898,8 @@ def cgls(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
                      shape=Op.shape, dtype=_vdtype(x0), niter=niter,
                      damp=damp, tol=tol, fused=use_fused,
                      normal=use_normal, guards=use_guards,
-                     telemetry=telemetry.telemetry_enabled()):
+                     telemetry=telemetry.telemetry_enabled()), \
+            _metrics.timer("solver.cgls"):
         if use_fused:
             x, iiter, cost, cost1, kold, _ = _run_cgls_fused(
                 Op, y, x0, x0_owned, niter, damp, tol, use_normal,
@@ -911,7 +925,8 @@ def cgls_guarded(Op, y: Vector, x0: Optional[Vector] = None,
                      shape=Op.shape, dtype=_vdtype(x0), niter=niter,
                      damp=damp, tol=tol, fused=True,
                      normal=bool(normal), guards=True,
-                     telemetry=telemetry.telemetry_enabled()):
+                     telemetry=telemetry.telemetry_enabled()), \
+            _metrics.timer("solver.cgls"):
         return _run_cgls_fused(Op, y, x0, x0_owned, niter, damp, tol,
                                bool(normal), True)
 

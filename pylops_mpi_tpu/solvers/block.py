@@ -46,6 +46,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..distributedarray import DistributedArray
+from ..diagnostics import metrics as _metrics
 from ..diagnostics import telemetry, trace as _trace
 from .basic import (_DONATE_X0, _donate_copy, _get_fused, _i32,
                     _mp_floor, _reject, _step_scalar, _vdtype, _vkey)
@@ -367,6 +368,8 @@ def block_cg(Op, y: DistributedArray,
             x, iiter, cost, status = fn(
                 y, x0 if x0_owned else _donate_copy(x0), tol)
             iiter = int(iiter)
+            _metrics.inc("solver.block_cg.solves")
+            _metrics.inc("solver.block_cg.iterations", iiter)
             _rstatus.record_columns(
                 "block_cg", [int(cd) for cd in np.asarray(status)],
                 iiter)
@@ -379,6 +382,8 @@ def block_cg(Op, y: DistributedArray,
         x, iiter, cost = fn(y, x0 if x0_owned else _donate_copy(x0),
                             tol)
         iiter = int(iiter)
+        _metrics.inc("solver.block_cg.solves")
+        _metrics.inc("solver.block_cg.iterations", iiter)
         return x, iiter, np.asarray(cost)[:iiter + 1]
 
 
@@ -428,6 +433,8 @@ def block_cgls(Op, y: DistributedArray,
             x, iiter, cost, cost1, kold, status = fn(
                 y, x0 if x0_owned else _donate_copy(x0), damp, tol)
             iiter = int(iiter)
+            _metrics.inc("solver.block_cgls.solves")
+            _metrics.inc("solver.block_cgls.iterations", iiter)
             _rstatus.record_columns(
                 "block_cgls", [int(cd) for cd in np.asarray(status)],
                 iiter)
@@ -440,6 +447,8 @@ def block_cgls(Op, y: DistributedArray,
             x, iiter, cost, cost1, kold = fn(
                 y, x0 if x0_owned else _donate_copy(x0), damp, tol)
             iiter = int(iiter)
+            _metrics.inc("solver.block_cgls.solves")
+            _metrics.inc("solver.block_cgls.iterations", iiter)
         kold = np.asarray(kold)
         istop = np.where(kold < tol, 1, 2)
         return (x, istop, iiter, kold,

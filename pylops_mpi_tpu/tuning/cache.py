@@ -34,6 +34,7 @@ import tempfile
 import threading
 from typing import Dict, Optional
 
+from ..diagnostics import metrics as _metrics
 from ..diagnostics import trace as _trace
 
 __all__ = ["SCHEMA_VERSION", "cache_path", "lookup", "store",
@@ -103,8 +104,12 @@ def lookup(key: str, path: Optional[str] = None) -> Optional[dict]:
     e.g. the offline CLI, may have just banked it)."""
     with _LOCK:
         if key in _MEM:
+            _metrics.inc("tuning.cache.hit")
             return _MEM[key]
-    return load_plans(path).get(key)
+    entry = load_plans(path).get(key)
+    _metrics.inc("tuning.cache.hit" if entry is not None
+                 else "tuning.cache.miss")
+    return entry
 
 
 class _file_lock:

@@ -40,11 +40,13 @@ backend — XLA collectives — so the seam carries different switches:
   ``comm_chunks=`` wins. Chunk counts that don't fit the axis fall
   back (logged) instead of erroring.
 - ``PYLOPS_MPI_TPU_TRACE`` / ``PYLOPS_MPI_TPU_TELEMETRY`` /
-  ``PYLOPS_MPI_TPU_TRACE_FILE`` / ``PYLOPS_MPI_TPU_PROFILE_DIR``: the
-  observability seams (round 9) — structured span tracing, in-loop
-  solver telemetry and ``jax.profiler`` capture. Resolved by
-  :mod:`pylops_mpi_tpu.diagnostics` (see ``docs/observability.md``),
-  not here, so the jax-free scripts can read them standalone.
+  ``PYLOPS_MPI_TPU_TRACE_FILE`` / ``PYLOPS_MPI_TPU_PROFILE_DIR`` /
+  ``PYLOPS_MPI_TPU_METRICS`` (``_FILE``, ``_INTERVAL``): the
+  observability seams (rounds 9/10) — structured span tracing, in-loop
+  solver telemetry, ``jax.profiler`` capture and the fleet metrics
+  registry. Resolved by :mod:`pylops_mpi_tpu.diagnostics` (see
+  ``docs/observability.md``), not here, so the jax-free scripts can
+  read them standalone.
 """
 
 from __future__ import annotations
@@ -200,6 +202,18 @@ KNOBS = [
     ("PYLOPS_MPI_TPU_ATTEMPT", "int>=0", "set by supervisor",
      "resilience/elastic.py, resilience/supervisor.py",
      "0-based relaunch counter of the supervised job"),
+    ("PYLOPS_MPI_TPU_METRICS", "off|on", "off",
+     "diagnostics/metrics.py (solvers, collectives, resilience, "
+     "tuning)",
+     "fleet metrics registry; off is zero-cost no-op handles and the "
+     "fused-solver HLO stays bit-identical"),
+    ("PYLOPS_MPI_TPU_METRICS_FILE", "path",
+     "unset (set by supervisor per worker)",
+     "diagnostics/metrics.py (resilience/supervisor.py)",
+     "periodic atomic JSON snapshot target of the metrics registry"),
+    ("PYLOPS_MPI_TPU_METRICS_INTERVAL", "seconds", "5.0",
+     "diagnostics/metrics.py",
+     "snapshot-write cadence of the background metrics writer"),
 ]
 
 

@@ -1,0 +1,114 @@
+"""Worker for the fleet-observability acceptance (ISSUE 10).
+
+Launched by ``resilience.launch_job`` (see
+``tests/test_fleet_obs.py::test_fleet_smoke_aggregation_names_straggler``)
+with ``PYLOPS_MPI_TPU_METRICS=on`` and ``PYLOPS_MPI_TPU_TRACE=spans``
+in the job env. Each worker:
+
+- joins the supervised world (``elastic_initialize``: heartbeat —
+  which now embeds the metrics snapshot — plus gloo bring-up when
+  world > 1);
+- points ``PYLOPS_MPI_TPU_TRACE_FILE`` at its own
+  ``$PYLOPS_FLEET_LOGDIR/trace.rank{r}.jsonl``;
+- runs a tiny LOCAL fused CGLS solve (solver span → critical-path
+  root; solver.cgls metrics counters);
+- dispatches ``N_WARM`` eager ``all_to_all_resharding`` calls on its
+  local 4-device mesh (collective spans with per-op sequence numbers);
+- on the straggler rank (``PYLOPS_FLEET_STALL_RANK``, default 1)
+  injects a ``faults.host_stall`` of ``PYLOPS_FLEET_STALL_S`` seconds;
+- dispatches ``N_POST`` more collectives and dumps its trace.
+
+The stall sits BETWEEN the warmup and post collectives, and
+``N_WARM > N_POST`` on purpose: the aggregation's clock alignment is
+the MEDIAN entry delta over all matched collectives, so the warmup
+majority anchors each rank's offset to its true clock and the
+post-stall collectives on the stalled rank surface as per-collective
+``skew_us`` with ``straggler_rank`` naming it. (A stall before ALL of
+a rank's collectives would instead be absorbed into the offset —
+indistinguishable from a late process start; see
+``diagnostics/aggregate.py``.)
+
+The eager collectives run on each rank's LOCAL mesh — cross-rank
+matching needs identical (op, seq) streams, not a shared data path,
+and gloo's all_to_all support is beside the point being tested.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4"
+                           ).strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+if int(os.environ.get("PYLOPS_MPI_TPU_NUM_PROCESSES", "1")) > 1:
+    try:  # cross-process CPU collectives (name varies across versions)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import numpy as np  # noqa: E402
+
+N_WARM = 6
+N_POST = 2
+
+
+def main() -> None:
+    from pylops_mpi_tpu.resilience.elastic import elastic_initialize
+    cfg = elastic_initialize()
+    rank = cfg.process_id or 0
+    logdir = os.environ["PYLOPS_FLEET_LOGDIR"]
+    trace_file = os.path.join(logdir, f"trace.rank{rank}.jsonl")
+    os.environ["PYLOPS_MPI_TPU_TRACE_FILE"] = trace_file
+
+    import pylops_mpi_tpu as pmt
+    from pylops_mpi_tpu.diagnostics import trace
+    from pylops_mpi_tpu.ops.local import MatrixMult
+    from pylops_mpi_tpu.parallel.collectives import all_to_all_resharding
+    from pylops_mpi_tpu.parallel.mesh import Mesh
+    from pylops_mpi_tpu.resilience import faults
+
+    # strictly-local mesh: jax.devices() is GLOBAL under gloo and
+    # rank 1 must not build a mesh over rank 0's devices
+    mesh = Mesh(np.asarray(jax.local_devices()), ("sp",))
+    pmt.set_default_mesh(mesh)
+
+    # tiny local solve: seed-0 so both ranks trace the same program
+    rng = np.random.default_rng(0)
+    n, nb = 8, 4
+    blocks = []
+    for _ in range(nb):
+        b = rng.standard_normal((n, n)) / np.sqrt(n)
+        np.fill_diagonal(b, b.diagonal() + 4.0)
+        blocks.append(b)
+    xt = rng.standard_normal(nb * n)
+    y = np.concatenate([b @ xt[i * n:(i + 1) * n]
+                        for i, b in enumerate(blocks)])
+    Op = pmt.MPIBlockDiag([MatrixMult(b, dtype=np.float32)
+                           for b in blocks], mesh=mesh)
+    dy = pmt.DistributedArray.to_dist(y.astype(np.float32), mesh=mesh)
+    _, _, iiter = pmt.cgls(Op, dy, niter=8, tol=0.0)[:3]
+
+    stall_rank = int(os.environ.get("PYLOPS_FLEET_STALL_RANK", "1"))
+    stall_s = float(os.environ.get("PYLOPS_FLEET_STALL_S", "0.6"))
+    import jax.numpy as jnp
+    xd = jnp.arange(16 * 16, dtype=jnp.float32).reshape(16, 16)
+
+    for _ in range(N_WARM):
+        all_to_all_resharding(xd, mesh, 0, 1).block_until_ready()
+    if rank == stall_rank:
+        faults.host_stall(stall_s)
+    for _ in range(N_POST):
+        all_to_all_resharding(xd, mesh, 0, 1).block_until_ready()
+
+    n_events = trace.dump(trace_file)
+    print(f"FLEET OK attempt={cfg.attempt} rank={rank} "
+          f"iiter={int(iiter)} events={n_events}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
