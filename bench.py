@@ -225,6 +225,112 @@ def _batched_race_row(niter=20):
         return {"error": repr(e)[:300]}
 
 
+def _hier_race_row():
+    """Hierarchical-vs-flat race (round 11 acceptance): declare the 8
+    virtual devices a 2x4 hybrid fabric and run one pencil transpose
+    and one (1, 8)-grid ring SUMMA both ways. On the CPU sim both
+    "fabrics" are the same silicon, so wall-clock is context only —
+    the acceptance number is DCN bytes per apply (flat/hier ≥ 3),
+    traced from the per-fabric collective counters and cross-checked
+    against the cost model; the timing evidence lands via the
+    ``tpu_hier`` cache merge on hardware harvests."""
+    saved = {k: os.environ.get(k) for k in
+             ("PYLOPS_MPI_TPU_FABRIC", "PYLOPS_MPI_TPU_METRICS",
+              "PYLOPS_MPI_TPU_HIERARCHICAL")}
+    try:
+        import numpy as _np
+        import jax as _jax
+        from pylops_mpi_tpu import (DistributedArray, MPIFFTND,
+                                    MPIMatrixMult)
+        from pylops_mpi_tpu.parallel.mesh import make_mesh_hybrid
+        from pylops_mpi_tpu.diagnostics import costmodel, metrics
+        if len(_jax.devices()) != 8:
+            return {"skipped": "needs 8 devices"}
+        os.environ["PYLOPS_MPI_TPU_FABRIC"] = "2x4"
+        os.environ["PYLOPS_MPI_TPU_METRICS"] = "on"
+        os.environ.pop("PYLOPS_MPI_TPU_HIERARCHICAL", None)
+        mesh_h = make_mesh_hybrid(dcn_size=2)
+        rng = _np.random.default_rng(11)
+
+        def _dcn(name):
+            snap = metrics.snapshot()
+            cnt = snap.get("counters", snap)
+            return cnt.get(f"collective.{name}.bytes_dcn", 0)
+
+        # --- pencil transpose: traced hier bytes vs the flat model
+        dims = (16, 8, 4)
+        x = (rng.standard_normal(dims)
+             + 1j * rng.standard_normal(dims)).ravel()
+        xd = DistributedArray.to_dist(x, mesh=mesh_h)
+        itemsize = int(_np.dtype(xd._arr.dtype).itemsize)
+        flat_cost = costmodel.pencil_transpose_cost(
+            dims, 8, itemsize=itemsize, n_transposes=1,
+            fabric_shape=(2, 4), hierarchical=False)
+        metrics.clear_metrics()
+        Oph = MPIFFTND(dims, axes=(0, 1), mesh=mesh_h, hierarchical="on")
+        _jax.block_until_ready(Oph.matvec(xd)._arr)
+        hier_dcn = _dcn("hier_pencil_transpose") / 2  # 2 per forward
+        pencil_ratio = (_sig3(flat_cost.dcn_bytes / hier_dcn)
+                        if hier_dcn else None)
+        # wall-clock context: one jitted forward each way
+        Opf = MPIFFTND(dims, axes=(0, 1), mesh=mesh_h,
+                       hierarchical="off")
+        fh = _jax.jit(lambda v: Oph.matvec(v)._arr)
+        ff = _jax.jit(lambda v: Opf.matvec(v)._arr)
+        for f in (fh, ff):
+            _jax.block_until_ready(f(xd))
+        t0 = time.perf_counter()
+        _jax.block_until_ready(fh(xd))
+        t_h = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _jax.block_until_ready(ff(xd))
+        t_f = time.perf_counter() - t0
+
+        # --- SUMMA ring on the slice-spanning (1, 8) axis: traced
+        # flat vs traced hier, both through collective.ring_pass
+        A = rng.standard_normal((24, 16))
+        X = rng.standard_normal((16, 8))
+        summa_dcn = {}
+        for tag, hier in (("flat", "off"), ("hier", "on")):
+            metrics.clear_metrics()
+            Op = MPIMatrixMult(A, 8, kind="summa", dtype=_np.float64,
+                               mesh=mesh_h, grid=(1, 8),
+                               schedule="gather", overlap="on",
+                               hierarchical=hier)
+            _ = Op.matvec(DistributedArray.to_dist(X.ravel(),
+                                                   mesh=mesh_h))
+            summa_dcn[tag] = _dcn("ring_pass")
+        summa_ratio = (_sig3(summa_dcn["flat"] / summa_dcn["hier"])
+                       if summa_dcn.get("hier") else None)
+        ratios = [r for r in (pencil_ratio, summa_ratio) if r]
+        return {
+            "fabric": "2x4",
+            "pencil": {"dims": list(dims), "itemsize": itemsize,
+                       "model_flat_dcn_bytes": int(flat_cost.dcn_bytes),
+                       "traced_hier_dcn_bytes": int(hier_dcn),
+                       "dcn_reduction": pencil_ratio,
+                       "time_hier_vs_flat": (_sig3(t_h / t_f)
+                                             if t_f else None)},
+            "summa": {"shape": [24, 16, 8], "grid": [1, 8],
+                      "flat_ring_dcn_bytes": int(summa_dcn["flat"]),
+                      "hier_ring_dcn_bytes": int(summa_dcn["hier"]),
+                      "dcn_reduction": summa_ratio},
+            "worst_dcn_reduction": min(ratios) if ratios else None}
+    except Exception as e:  # the race must never cost the headline
+        return {"error": repr(e)[:300]}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            from pylops_mpi_tpu.diagnostics import metrics as _m
+            _m.clear_metrics()
+        except Exception:
+            pass
+
+
 # dense matmul peak per chip, TFLOP/s (bf16 inputs, f32 accumulation on
 # the MXU) — public spec-sheet numbers; most-specific key checked first
 _PEAK_TFLOPS = [
@@ -851,6 +957,15 @@ def child_main():
         _progress("batched-throughput race (block-CGLS vs sequential)")
         batched = _batched_race_row()
 
+    # hierarchical-vs-flat race (round 11): per-fabric DCN bytes on
+    # the simulated 2x4 hybrid, every CPU-sim round;
+    # BENCH_HIER_PYLOPS_MPI_TPU=1 forces it on hardware too
+    hier_race = None
+    hier_env = os.environ.get("BENCH_HIER_PYLOPS_MPI_TPU", "")
+    if hier_env != "0" and (not on_tpu or hier_env == "1"):
+        _progress("hierarchical-vs-flat race (2x4 hybrid DCN bytes)")
+        hier_race = _hier_race_row()
+
     peak_bf16 = _peak_flops_per_chip(jax.devices()[0], "bf16")
     peak_f32 = _peak_flops_per_chip(jax.devices()[0], "f32_highest")
     peak_hbm = _peak_hbm_gbps(jax.devices()[0]) if on_tpu else None
@@ -999,6 +1114,7 @@ def child_main():
         **({"bf16_race": bf16_race} if bf16_race else {}),
         **({"tune_race": tune_race} if tune_race else {}),
         **({"batched": batched} if batched else {}),
+        **({"hierarchical_vs_flat": hier_race} if hier_race else {}),
         **({"selfcheck": selfcheck} if selfcheck is not None else {}),
         **({"cpu_breakdown": cpu_breakdown} if cpu_breakdown else {}),
     }
@@ -1211,7 +1327,8 @@ def _merge_tpu_cache(result, root=None):
                              "degraded", "tpu_error", "components",
                              "cpu_breakdown", "flagship_1dev_cpu",
                              "roofline", "f32", "bf16", "plan",
-                             "tune_race", "batched")
+                             "tune_race", "batched",
+                             "hierarchical_vs_flat")
                             if k in result}
                 result = dict(r)
                 result["cached"] = True
@@ -1229,6 +1346,11 @@ def _merge_tpu_cache(result, root=None):
                 # TPU headline
                 if cpu_live.get("batched") is not None:
                     result["batched"] = cpu_live["batched"]
+                # and the hierarchical DCN-byte race: a live CPU-sim
+                # attribution that must ride every compact line
+                if cpu_live.get("hierarchical_vs_flat") is not None:
+                    result["hierarchical_vs_flat"] = \
+                        cpu_live["hierarchical_vs_flat"]
                 result.setdefault("plan", "default")
                 # every TPU row carries an HBM qualifier; a legacy
                 # banked artifact predating the hbm_pct schema gets an
@@ -1357,6 +1479,19 @@ def _merge_tpu_cache(result, root=None):
                        "ici_bytes_per_chunk", "shape", "error")
                       if row.get(k) is not None}
                      for row in r["rows"] if isinstance(row, dict)]}
+    ent = cache.get("hier") or {}
+    r = ent.get("result")
+    # hierarchical-race stage (round 11): hardware evidence only — the
+    # CPU-sim DCN-byte attribution rides the live row every round; a
+    # TPU harvest adds the wall-clock side the sim cannot measure
+    # (both fabrics are the same silicon there)
+    if (r and r.get("platform") == "tpu" and "tpu_hier" not in result):
+        result["tpu_hier"] = {
+            "ts": ent.get("ts"), "code_rev": ent.get("code_rev"),
+            **{k: r.get(k) for k in
+               ("fabric", "pencil", "summa", "worst_dcn_reduction",
+                "error")
+               if r.get(k) is not None}}
     ent = cache.get("diag") or {}
     r = ent.get("result")
     # same hardware-evidence rule as the selfcheck merge above: a diag
@@ -1609,6 +1744,17 @@ def _compact_line(result):
             if tr.get(k) is not None}
     elif tr.get("error"):
         compact["tune_race"] = {"error": tr["error"][:120]}
+    hr = result.get("hierarchical_vs_flat") or {}
+    if hr and not hr.get("error"):
+        compact["hier"] = {k: v for k, v in (
+            ("pencil_dcn_reduction",
+             (hr.get("pencil") or {}).get("dcn_reduction")),
+            ("summa_dcn_reduction",
+             (hr.get("summa") or {}).get("dcn_reduction")),
+            ("worst_dcn_reduction", hr.get("worst_dcn_reduction")),
+        ) if v is not None}
+    elif hr.get("error"):
+        compact["hier"] = {"error": hr["error"][:120]}
     rl = result.get("roofline") or {}
     if rl and not rl.get("error"):
         compact["roofline"] = {
@@ -1647,6 +1793,14 @@ def _compact_line(result):
         compact["overlap"] = {
             row.get("bench"): row.get("pipelined_vs_bulk")
             for row in ov.get("rows", []) if isinstance(row, dict)}
+    th = result.get("tpu_hier") or {}
+    if th:
+        compact["tpu_hier"] = {
+            k: th.get(k) for k in
+            ("worst_dcn_reduction",) if th.get(k) is not None}
+        ptime = (th.get("pencil") or {}).get("time_hier_vs_flat")
+        if ptime is not None:
+            compact["tpu_hier"]["pencil_time_hier_vs_flat"] = ptime
     fp = result.get("tpu_fft_planar") or {}
     if fp:
         pr = fp.get("probes") or {}
@@ -1672,7 +1826,7 @@ def _compact_line(result):
     # hard ≤2KB guarantee: shed optional detail, most-expendable first
     for victim in ("sentinel", "probe", "roofline", "components", "bf16_race",
                    "bf16", "f32", "flagship_1dev_cpu", "tpu_breakdown",
-                   "overlap", "fft_planar", "selfcheck"):
+                   "overlap", "tpu_hier", "fft_planar", "selfcheck"):
         if len(json.dumps(compact)) <= 2000:
             break
         compact.pop(victim, None)

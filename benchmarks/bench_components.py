@@ -843,6 +843,27 @@ def overlap_stage(quick: bool = False) -> dict:
             "n_devices": len(jax.devices()), "rows": rows}
 
 
+def hier_stage() -> dict:
+    """The harvest-ladder hierarchical stage (round 11): the
+    hierarchical-vs-flat race row (bench._hier_race_row — per-fabric
+    DCN bytes on the 2x4 hybrid plus the wall-clock side only hardware
+    can measure) as ONE JSON object for the probe daemon. On real
+    slices the FABRIC override the row exports is redundant but
+    harmless (topology classifies by name first)."""
+    import time as _time
+    import importlib.util as _ilu
+    import jax
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = _ilu.spec_from_file_location(
+        "bench", os.path.join(root, "bench.py"))
+    bench = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    row = bench._hier_race_row()
+    return {"kind": "hier_stage", "ts": _time.time(),
+            "platform": jax.default_backend(),
+            "n_devices": len(jax.devices()), **row}
+
+
 def main(quick: bool = False, only=None):
     for r in run_components(quick=quick, only=only):
         print(json.dumps(r))
@@ -858,6 +879,9 @@ if __name__ == "__main__":
         jax.config.update("jax_platforms", "cpu")
     if "--overlap-stage" in sys.argv:
         print(json.dumps(overlap_stage(quick="--quick" in sys.argv)))
+        sys.exit(0)
+    if "--hier-stage" in sys.argv:
+        print(json.dumps(hier_stage()))
         sys.exit(0)
     only = None
     if "--only" in sys.argv:

@@ -105,7 +105,8 @@ def _budget(stage: str, rehearse: bool = False) -> int:
     literals as a last-resort fallback."""
     _FALLBACK = {"selfcheck": 900, "tune": 600, "flagship_small": 900,
                  "fft_planar": 700, "flagship_full": 3000,
-                 "flagship_mid": 1200, "overlap": 600, "bisect": 1200,
+                 "flagship_mid": 1200, "overlap": 600, "hier": 300,
+                 "bisect": 1200,
                  "breakdown": 900, "diag": 900}
     mod = _profiler_mod()
     if mod is None:
@@ -256,6 +257,19 @@ def _stage_overlap(env, timeout):
         env, timeout=timeout, cwd=_ROOT)
 
 
+def _stage_hier(env, timeout):
+    """Hierarchical-vs-flat race (round 11): the per-fabric DCN-byte
+    attribution plus the wall-clock side only real ICI/DCN silicon can
+    measure (bench_components.py --hier-stage). Cheap; slotted right
+    after overlap so it shares the post-flagship slot."""
+    stage_env = dict(env)
+    stage_env["BENCH_HIER_PYLOPS_MPI_TPU"] = "1"  # run on hardware too
+    return _bench_mod()._run_json_cmd(
+        [sys.executable, "-u",
+         os.path.join(_HERE, "bench_components.py"), "--hier-stage"],
+        stage_env, timeout=timeout, cwd=_ROOT)
+
+
 def _stage_breakdown(env, timeout):
     """Latency attribution for the flagship (benchmarks/tpu_breakdown.py):
     fixed-vs-marginal niter fit, standalone sweep time, reduction
@@ -361,6 +375,7 @@ def harvest(cache: dict, rehearse: bool = False,
         # overlap races sit AFTER the flagship stages by design (ISSUE
         # 3): a schedule race must never push the N=4096 headline back
         ("overlap", lambda t: _stage_overlap(env, t)),
+        ("hier", lambda t: _stage_hier(env, t)),
         ("bisect", lambda t: _stage_bisect(env, t)),
         ("breakdown", lambda t: _stage_breakdown(env, t)),
         ("diag", lambda t: _stage_diag(env, t)),

@@ -37,7 +37,12 @@ PAGES = {
           "best_grid_2d", "local_device_count"]),
         ("Explicit collectives", "pylops_mpi_tpu.parallel.collectives",
          ["all_to_all_resharding", "ring_halo_extend", "cart_halo_extend",
-          "halo_slab"]),
+          "halo_slab", "ring_pass", "hier_pencil_transpose",
+          "hier_psum_scatter", "hier_all_gather"]),
+        ("Fabric topology", "pylops_mpi_tpu.parallel.topology",
+         ["fabric_override", "axis_fabric", "mesh_fabrics", "is_hybrid",
+          "hybrid_axes", "topology_key", "collective_fabric", "slice_map",
+          "slice_run", "perm_crossings"]),
     ],
     "operators": [
         ("Templates", "pylops_mpi_tpu",
@@ -129,7 +134,8 @@ PAGES = {
         ("Decorators", "pylops_mpi_tpu.utils.decorators", ["reshaped"]),
         ("Feature flags", "pylops_mpi_tpu.utils.deps",
          ["platform_override", "explicit_stencil_enabled", "x64_enabled",
-          "matmul_precision", "apply_environment"]),
+          "matmul_precision", "apply_environment", "hierarchical_mode",
+          "hierarchical_enabled"]),
         ("Native host runtime", "pylops_mpi_tpu.native",
          ["available", "pack_padded", "unpack_padded", "read_binary",
           "write_binary", "write_binary_at", "local_split_native"]),
@@ -143,7 +149,8 @@ PAGES = {
         ("Cost models and roofline",
          "pylops_mpi_tpu.diagnostics.costmodel",
          ["OpCost", "estimate", "register_cost", "roofline",
-          "summa_comm_volume", "pencil_transpose_cost", "peak_flops",
+          "summa_comm_volume", "summa_comm_volume_split",
+          "pencil_transpose_cost", "peak_flops",
           "peak_hbm_gbps", "peak_ici_gbps", "device_peaks"]),
         ("In-loop solver telemetry",
          "pylops_mpi_tpu.diagnostics.telemetry",

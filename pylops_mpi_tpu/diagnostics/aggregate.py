@@ -176,7 +176,8 @@ def merge_traces(traces: Dict[int, Sequence[Dict]]) -> Dict:
         {"events":      clock-aligned merged events, pid=rank,
          "offsets_us":  {rank: applied offset},
          "collectives": [{"name", "seq", "skew_us", "straggler_rank",
-                          "entries_us": {rank: aligned entry}}, ...],
+                          "entries_us": {rank: aligned entry},
+                          "fabric"?: "ici"|"dcn"|"split"}, ...],
          "ranks":       sorted rank list}
 
     Every collective matched across ≥2 ranks carries ``skew_us`` and
@@ -184,6 +185,29 @@ def merge_traces(traces: Dict[int, Sequence[Dict]]) -> Dict:
     merged events' ``args`` so Perfetto shows them on the span."""
     entries = {r: collective_entries(evs) for r, evs in traces.items()}
     offsets = align_offsets(entries)
+
+    # fabric attribution (round 11): the collectives stamp a ``fabric``
+    # span tag ("ici"/"dcn" for single-fabric dispatches, "split" for
+    # two-level schedules) on classified meshes; lift it onto the
+    # matched-collective summary so the fleet view shows which
+    # interconnect each straggler analysis rode. First rank's tag wins
+    # (the dispatch is SPMD — tags cannot differ across ranks).
+    fabrics: Dict[Tuple, str] = {}
+    for rank, evs in traces.items():
+        fallback_idx: Dict[str, int] = {}
+        for ev in evs:
+            if not isinstance(ev, dict) or ev.get("cat") != "collective" \
+                    or ev.get("ph") not in ("X", "B"):
+                continue
+            args = ev.get("args") if isinstance(ev.get("args"), dict) \
+                else {}
+            seq = args.get("seq")
+            if not isinstance(seq, int):
+                seq = fallback_idx.get(ev["name"], 0)
+                fallback_idx[ev["name"]] = seq + 1
+            fab = args.get("fabric")
+            if isinstance(fab, str):
+                fabrics.setdefault((ev["name"], seq), fab)
 
     # per-collective skew/straggler from ALIGNED entry times
     per_key: Dict[Tuple, Dict[int, float]] = {}
@@ -204,6 +228,8 @@ def merge_traces(traces: Dict[int, Sequence[Dict]]) -> Dict:
                "straggler_rank": straggler,
                "entries_us": {str(r): round(t, 3)
                               for r, t in sorted(aligned.items())}}
+        if key in fabrics:
+            rec["fabric"] = fabrics[key]
         collectives.append(rec)
         stamp[key] = rec
 

@@ -50,10 +50,12 @@ from typing import Callable, Dict, Optional, Tuple
 import numpy as np
 
 __all__ = ["OpCost", "estimate", "register_cost", "roofline",
-           "summa_comm_volume", "pencil_transpose_cost",
+           "summa_comm_volume", "summa_comm_volume_split",
+           "pencil_transpose_cost",
            "peak_flops", "peak_hbm_gbps", "peak_ici_gbps",
+           "peak_dcn_gbps",
            "device_peaks", "PEAK_TFLOPS", "PEAK_HBM_GBPS",
-           "PEAK_ICI_GBPS"]
+           "PEAK_ICI_GBPS", "PEAK_DCN_GBPS"]
 
 
 # ------------------------------------------------------------- peak tables
@@ -88,6 +90,19 @@ PEAK_ICI_GBPS = [
     ("v4", 300.0), ("v3", 280.0), ("v2", 160.0),
 ]
 
+# APPROXIMATE per-chip DCN bandwidth, GB/s (round 11): the inter-slice
+# fabric is the hosts' datacenter NICs shared by each host's local
+# chips — roughly a 100-200 Gb/s NIC over 4 chips. Like the ICI table
+# this is for roofline PLACEMENT and for the ~10-30x ICI:DCN ratio the
+# hierarchical schedules exploit, not for bandwidth claims; unknown
+# chips get NO DCN roofline. Single-slice deployments never produce
+# dcn_bytes, so these entries are inert off multislice.
+PEAK_DCN_GBPS = [
+    ("v6e", 12.5), ("v6 lite", 12.5), ("v6", 12.5),
+    ("v5p", 25.0), ("v5e", 6.25), ("v5 lite", 6.25), ("v5", 25.0),
+    ("v4", 6.25), ("v3", 6.25), ("v2", 6.25),
+]
+
 
 def _lookup(table, device_kind: str) -> Optional[float]:
     kind = (device_kind or "").lower()
@@ -120,6 +135,12 @@ def peak_ici_gbps(device_kind: str) -> Optional[float]:
     return _lookup(PEAK_ICI_GBPS, device_kind)
 
 
+def peak_dcn_gbps(device_kind: str) -> Optional[float]:
+    """APPROXIMATE per-chip DCN (inter-slice) bandwidth, GB/s (see
+    table note); None for unknown chips."""
+    return _lookup(PEAK_DCN_GBPS, device_kind)
+
+
 def device_peaks(device=None, mode: str = "bf16") -> Dict:
     """Peak dict for :func:`roofline` from a live ``jax.Device``
     (default: ``jax.devices()[0]``): ``{"flops", "hbm_gbps",
@@ -132,10 +153,12 @@ def device_peaks(device=None, mode: str = "bf16") -> Dict:
     platform = getattr(device, "platform", "")
     if platform != "tpu":
         return {"flops": None, "hbm_gbps": None, "ici_gbps": None,
+                "dcn_gbps": None,
                 "device_kind": kind, "platform": platform}
     return {"flops": peak_flops(kind, mode),
             "hbm_gbps": peak_hbm_gbps(kind),
             "ici_gbps": peak_ici_gbps(kind),
+            "dcn_gbps": peak_dcn_gbps(kind),
             "device_kind": kind, "platform": platform}
 
 
@@ -143,27 +166,39 @@ def device_peaks(device=None, mode: str = "bf16") -> Dict:
 @dataclass
 class OpCost:
     """Cost of ONE operator apply, PER DEVICE: floating-point
-    operations, HBM bytes streamed, ICI bytes received. ``notes``
-    carries model provenance (which registry entry, which schedule)."""
+    operations, HBM bytes streamed, ICI bytes received — and, on
+    hybrid meshes (round 11), DCN bytes received, split out because
+    the two fabrics differ by ~10-30x in bandwidth and a single
+    "inter-chip bytes" number hides exactly what the hierarchical
+    schedules optimize. ``ici_bytes`` stays the intra-slice share (NOT
+    the total), so ``ici + dcn`` is total off-chip traffic; flat
+    meshes keep ``dcn_bytes == 0`` and every pre-round-11 model reads
+    unchanged. ``dcn_bytes`` sits after ``notes`` so existing
+    positional constructors keep their meaning. ``notes`` carries
+    model provenance (which registry entry, which schedule)."""
 
     flops: float = 0.0
     hbm_bytes: float = 0.0
     ici_bytes: float = 0.0
     notes: Tuple[str, ...] = field(default_factory=tuple)
+    dcn_bytes: float = 0.0
 
     def __add__(self, other: "OpCost") -> "OpCost":
         return OpCost(self.flops + other.flops,
                       self.hbm_bytes + other.hbm_bytes,
                       self.ici_bytes + other.ici_bytes,
-                      self.notes + other.notes)
+                      self.notes + other.notes,
+                      self.dcn_bytes + other.dcn_bytes)
 
     def scaled(self, k: float) -> "OpCost":
         return OpCost(self.flops * k, self.hbm_bytes * k,
-                      self.ici_bytes * k, self.notes)
+                      self.ici_bytes * k, self.notes,
+                      self.dcn_bytes * k)
 
     def as_dict(self) -> Dict:
         return {"flops": self.flops, "hbm_bytes": self.hbm_bytes,
-                "ici_bytes": self.ici_bytes, "notes": list(self.notes)}
+                "ici_bytes": self.ici_bytes,
+                "dcn_bytes": self.dcn_bytes, "notes": list(self.notes)}
 
 
 def _itemsize(dt) -> int:
@@ -203,43 +238,97 @@ def summa_comm_volume(N: int, K: int, M: int,
     Returns ``{"gather": ..., "stat_a": ..., "adjoint": ...}``
     (adjoint = the stationary-A Y-gather + r-psum schedule).
     """
+    split = summa_comm_volume_split(N, K, M, grid)
+    return {k: v["r"] + v["c"] for k, v in split.items()}
+
+
+def summa_comm_volume_split(N: int, K: int, M: int,
+                            grid: Tuple[int, int]
+                            ) -> Dict[str, Dict[str, float]]:
+    """:func:`summa_comm_volume` split BY GRID AXIS — per schedule,
+    the per-device element volume received over the ``r`` (row) and
+    ``c`` (column) axis collectives separately. This is the per-fabric
+    attribution seam (round 11): on a hybrid mesh whose grid is
+    fabric-aligned (rows = slices, so ``r`` collectives ride DCN and
+    ``c`` collectives ride ICI — the layout ``ops/matrixmult.py`` pins
+    when the hierarchical seam is on), each axis's volume IS that
+    fabric's bytes. A topology-blind schedule gets the conservative
+    charge instead: with no pinned axis→fabric assignment, every
+    collective may ride the slow fabric, so the whole total is
+    DCN-attributed (how the flat baseline of the ``hierarchical_vs_flat``
+    bench row and the ≥3x acceptance ratio are counted)."""
     pr, pc = int(grid[0]), int(grid[1])
     Np = pr * math.ceil(N / pr)
     Kp_r = pr * math.ceil(K / pr)
     Kp_c = pc * math.ceil(K / pc)
     Mp = pc * math.ceil(M / pc)
-    vol_gather = ((Np // pr) * Kp_c * (pc - 1) / pc
-                  + Kp_r * (Mp // pc) * (pr - 1) / pr)
-    vol_stat_a = (Kp_r * (Mp // pc) * (pr - 1) / pr
-                  + Kp_r * Mp * (pc - 1) / pc
-                  + (Np // pr) * Mp * (pc - 1) / pc)
+    gather = {"c": (Np // pr) * Kp_c * (pc - 1) / pc,
+              "r": Kp_r * (Mp // pc) * (pr - 1) / pr}
+    stat_a = {"r": Kp_r * (Mp // pc) * (pr - 1) / pr,
+              "c": (Kp_r * Mp * (pc - 1) / pc
+                    + (Np // pr) * Mp * (pc - 1) / pc)}
     # adjoint: gather Y row along 'c' ((Np/pr, Mp) result), then psum
     # the (Kp_c/pc, Mp) partial over 'r' (ring all-reduce ~ 2(pr-1)/pr)
-    vol_adj = ((Np // pr) * Mp * (pc - 1) / pc
-               + (Kp_c // pc) * Mp * 2 * (pr - 1) / pr)
-    return {"gather": vol_gather, "stat_a": vol_stat_a,
-            "adjoint": vol_adj}
+    adjoint = {"c": (Np // pr) * Mp * (pc - 1) / pc,
+               "r": (Kp_c // pc) * Mp * 2 * (pr - 1) / pr}
+    return {"gather": gather, "stat_a": stat_a, "adjoint": adjoint}
 
 
 def pencil_transpose_cost(shape: Tuple[int, ...], n_dev: int,
                           itemsize: int = 8,
-                          n_transposes: int = 2) -> OpCost:
-    """ICI cost of the distributed FFT's pencil transpose(s): each
-    tiled all-to-all of the full array moves ``(P-1)/P`` of the local
-    block off-chip, regardless of chunking (``chunked_pencil_transpose``
-    streams the SAME bytes in K pieces). ``itemsize`` is the element
-    size on the wire — 8 for c64, 2×4 for the planar (re, im) f32
-    plane pair (identical bytes for the full spectrum; ~half for a
-    real transform's half-spectrum, which the caller accounts by
-    passing the half-spectrum shape). HBM term: one read + one write
-    of the local block per transpose."""
+                          n_transposes: int = 2,
+                          fabric_shape: Optional[Tuple[int, int]] = None,
+                          hierarchical: bool = False) -> OpCost:
+    """Off-chip cost of the distributed FFT's pencil transpose(s):
+    each tiled all-to-all of the full array moves ``(P-1)/P`` of the
+    local block off-chip, regardless of chunking
+    (``chunked_pencil_transpose`` streams the SAME bytes in K pieces).
+    ``itemsize`` is the element size on the wire — 8 for c64, 2×4 for
+    the planar (re, im) f32 plane pair (identical bytes for the full
+    spectrum; ~half for a real transform's half-spectrum, which the
+    caller accounts by passing the half-spectrum shape). HBM term: one
+    read + one write of the local block per transpose.
+
+    ``fabric_shape=(D, I)`` (round 11) splits the off-chip bytes per
+    fabric on a D-slice hybrid mesh of I devices each:
+
+    - ``hierarchical=True`` — the two-level schedule
+      (:func:`~pylops_mpi_tpu.parallel.collectives.hier_pencil_transpose`):
+      the intra-slice all-to-all moves ``(I-1)/I`` of the local block
+      on ICI, the staged inter-slice exchange ``(D-1)/D`` on DCN.
+    - ``hierarchical=False`` — the topology-blind baseline. A flat
+      tuple-axis all-to-all on a hybrid mesh does NOT lower to a
+      pointwise exchange: GSPMD's portable cross-slice decomposition
+      gathers the array (the generic-reshard lowering ``ops/fft.py``
+      documents for multi-axis meshes), so each device receives
+      ``(I-1)`` local blocks over ICI and ``(P-I)`` over DCN — the
+      D-fold DCN inflation the hierarchical schedule removes.
+
+    ``fabric_shape=None`` (flat mesh) keeps the pre-round-11 model
+    verbatim: all off-chip bytes in ``ici_bytes``, ``dcn_bytes == 0``.
+    """
     n_total = float(np.prod(shape))
     local_bytes = n_total * itemsize / max(n_dev, 1)
     frac = (n_dev - 1) / n_dev if n_dev > 1 else 0.0
+    ici = local_bytes * frac * n_transposes
+    dcn = 0.0
+    notes = (f"pencil_transpose x{n_transposes}",)
+    if fabric_shape is not None:
+        d, i = int(fabric_shape[0]), int(fabric_shape[1])
+        if d > 1 and i >= 1 and d * i == n_dev:
+            if hierarchical:
+                ici = local_bytes * (i - 1) / i * n_transposes
+                dcn = local_bytes * (d - 1) / d * n_transposes
+                notes = (f"pencil_transpose x{n_transposes} "
+                         f"hier[dcn{d}xici{i}]",)
+            else:
+                ici = local_bytes * (i - 1) * n_transposes
+                dcn = local_bytes * (n_dev - i) * n_transposes
+                notes = (f"pencil_transpose x{n_transposes} "
+                         f"flat-on-hybrid[dcn{d}xici{i}:gather]",)
     return OpCost(flops=0.0,
                   hbm_bytes=2.0 * local_bytes * n_transposes,
-                  ici_bytes=local_bytes * frac * n_transposes,
-                  notes=(f"pencil_transpose x{n_transposes}",))
+                  ici_bytes=ici, notes=notes, dcn_bytes=dcn)
 
 
 # ------------------------------------------------------------ the registry
@@ -295,6 +384,33 @@ def _cost_block_matmul(op, direction: str) -> OpCost:
     return OpCost(flops, a_bytes + vec, ici, ("block.adjoint+psum",))
 
 
+def _summa_fabric_split(op, bytes_r: float,
+                        bytes_c: float) -> Tuple[float, float, str]:
+    """``(ici_bytes, dcn_bytes, note)`` attribution of SUMMA's
+    per-grid-axis comm bytes (round 11). Flat mesh: everything is ICI
+    (the pre-round-11 model). Hybrid mesh + fabric-aligned
+    hierarchical schedule (``op._hier``): each grid axis is charged to
+    the fabric it actually spans (rows = slices, so ``r`` rides DCN
+    and ``c`` rides ICI for the aligned layout). Hybrid mesh +
+    topology-blind schedule: conservative slow-fabric charge — with no
+    pinned axis→fabric assignment every collective may cross DCN."""
+    mesh2 = getattr(op, "mesh2", None)
+    if mesh2 is None:
+        return bytes_r + bytes_c, 0.0, ""
+    from ..parallel import topology as _topo
+    if not _topo.is_hybrid(mesh2):
+        return bytes_r + bytes_c, 0.0, ""
+    if not getattr(op, "_hier", False):
+        return 0.0, bytes_r + bytes_c, "+fabric[blind:dcn]"
+    fr = _topo.axis_fabric(mesh2, "r")
+    fc = _topo.axis_fabric(mesh2, "c")
+    ici = ((bytes_r if fr == "ici" else 0.0)
+           + (bytes_c if fc == "ici" else 0.0))
+    dcn = ((bytes_r if fr == "dcn" else 0.0)
+           + (bytes_c if fc == "dcn" else 0.0))
+    return ici, dcn, f"+fabric[r={fr},c={fc}]"
+
+
 def _cost_summa_matmul(op, direction: str) -> OpCost:
     pr, pc = op.grid
     P = pr * pc
@@ -303,23 +419,28 @@ def _cost_summa_matmul(op, direction: str) -> OpCost:
     ff = _flop_factor(op.dtype)
     flops = 2.0 * ff * op.Np * op.Kp_c * op.Mp / P
     a_bytes = op.Np * op.Kp_c * it_a / P
-    vols = summa_comm_volume(op.N, op.K, op.M, op.grid)
+    split = summa_comm_volume_split(op.N, op.K, op.M, op.grid)
     if direction == "forward":
         sched = getattr(op, "schedule", "gather")
-        vol = vols.get(sched, vols["gather"])
-        # A moves narrow (gather schedule's first term), X moves wide;
+        sp = split.get(sched, split["gather"])
+        # A moves narrow (gather schedule's c-axis term), X moves wide;
         # approximate with the A-row term at it_a and the rest at it_v
         if sched == "gather":
             a_term = (op.Np // pr) * op.Kp_c * (pc - 1) / pc
-            ici = a_term * it_a + (vol - a_term) * it_v
+            bytes_c = a_term * it_a + (sp["c"] - a_term) * it_v
         else:
-            ici = vol * it_v
+            bytes_c = sp["c"] * it_v
+        bytes_r = sp["r"] * it_v
+        ici, dcn, fnote = _summa_fabric_split(op, bytes_r, bytes_c)
         vec = (op.Kp_r * op.Mp / P + op.Np * op.Mp / P) * it_v
         return OpCost(flops, a_bytes + vec, ici,
-                      (f"summa.forward[{sched}]",))
-    ici = vols["adjoint"] * it_v
+                      (f"summa.forward[{sched}]{fnote}",), dcn)
+    sp = split["adjoint"]
+    ici, dcn, fnote = _summa_fabric_split(op, sp["r"] * it_v,
+                                          sp["c"] * it_v)
     vec = (op.Np * op.Mp / P + op.Kp_c * op.Mp / pc) * it_v
-    return OpCost(flops, a_bytes + vec, ici, ("summa.adjoint",))
+    return OpCost(flops, a_bytes + vec, ici,
+                  (f"summa.adjoint{fnote}",), dcn)
 
 
 def _cost_blockdiag(op, direction: str) -> OpCost:
@@ -397,9 +518,20 @@ def _cost_fft(op, direction: str) -> OpCost:
     flops = sum(5.0 * n_total * math.log2(max(2, dims[ax]))
                 for ax in axes) / P
     n_t = max(0, len(axes) - 1)  # one transpose per non-local axis pair
-    cost = pencil_transpose_cost(dims, P, itemsize=8, n_transposes=n_t)
+    fab = None
+    mesh = getattr(op, "mesh", None)
+    if mesh is not None:
+        from ..parallel import topology as _topo
+        h = _topo.hybrid_axes(mesh)
+        if h is not None:
+            fab = (h[2], h[3])
+    cost = pencil_transpose_cost(dims, P, itemsize=8, n_transposes=n_t,
+                                 fabric_shape=fab,
+                                 hierarchical=bool(
+                                     getattr(op, "_hier", False)))
     return OpCost(flops, cost.hbm_bytes + 2 * n_total * 8 / P,
-                  cost.ici_bytes, ("fft.pencil",) + cost.notes)
+                  cost.ici_bytes, ("fft.pencil",) + cost.notes,
+                  cost.dcn_bytes)
 
 
 def _cost_derivative(op, direction: str) -> OpCost:
@@ -473,7 +605,9 @@ def roofline(cost: OpCost, peaks: Dict, n_dev: int = 1,
              measured_s: Optional[float] = None) -> Dict:
     """Place an :class:`OpCost` on the roofline: per-component times
     (``flops / peak_flops``, ``hbm_bytes / hbm_bw``, ``ici_bytes /
-    ici_bw``; the cost is PER DEVICE, the peaks PER CHIP, so ``n_dev``
+    ici_bw``, and — when the cost carries a hybrid-mesh split —
+    ``dcn_bytes / dcn_bw``; the cost is PER DEVICE, the peaks PER
+    CHIP, so ``n_dev``
     only scales aggregate reporting), predicted seconds = max of the
     available components (a perfectly-overlapped execution's lower
     bound), and ``bound`` = the component that dominates. Components
@@ -496,6 +630,8 @@ def roofline(cost: OpCost, peaks: Dict, n_dev: int = 1,
         comps["hbm"] = cost.hbm_bytes / (peaks["hbm_gbps"] * 1e9)
     if peaks.get("ici_gbps") and cost.ici_bytes:
         comps["ici"] = cost.ici_bytes / (peaks["ici_gbps"] * 1e9)
+    if peaks.get("dcn_gbps") and cost.dcn_bytes:
+        comps["dcn"] = cost.dcn_bytes / (peaks["dcn_gbps"] * 1e9)
     if not comps:
         return {"predicted_s": None, "bound": None, "components_s": {},
                 "cost": cost.as_dict(), "n_dev": n_dev}

@@ -55,7 +55,8 @@ import time
 from typing import Dict, Optional
 
 __all__ = ["metrics_mode", "metrics_enabled", "metrics_file",
-           "metrics_interval", "inc", "set_gauge", "observe", "timer",
+           "metrics_interval", "inc", "collective_bytes", "set_gauge",
+           "observe", "timer",
            "snapshot", "clear_metrics", "write_snapshot",
            "read_snapshot", "SNAPSHOT_SCHEMA"]
 
@@ -127,6 +128,26 @@ def inc(name: str, value: float = 1) -> None:
     with _LOCK:
         _COUNTERS[name] = _COUNTERS.get(name, 0) + value
     _maybe_start_writer()
+
+
+def collective_bytes(name: str, nbytes: float,
+                     fabric: Optional[str] = None) -> None:
+    """Per-fabric collective byte accounting (round 11 bugfix):
+    ``collective.{name}.bytes`` used to lump ICI and DCN traffic into
+    one number, which made the hierarchical schedules' whole point —
+    moving bytes OFF the slow fabric — invisible in the registry. The
+    aggregate counter still carries every byte (dashboards keyed on it
+    keep working, and flat meshes — ``fabric=None`` — see no new
+    counters at all); when the caller resolves a fabric via
+    :mod:`pylops_mpi_tpu.parallel.topology`, the same bytes ALSO land
+    in ``collective.{name}.bytes_ici`` / ``.bytes_dcn``. A split
+    emission (one call per fabric share of a two-level collective) sums
+    back to the legacy counter by construction."""
+    if metrics_mode() == "off":
+        return
+    inc(f"collective.{name}.bytes", nbytes)
+    if fabric in ("ici", "dcn"):
+        inc(f"collective.{name}.bytes_{fabric}", nbytes)
 
 
 def set_gauge(name: str, value: float) -> None:

@@ -60,6 +60,9 @@ STAGE_BUDGETS: Dict[str, Dict[str, Optional[int]]] = {
     "flagship_full":  {"tpu": 3000, "rehearse": 2400},
     "flagship_mid":   {"tpu": 1200, "rehearse": 1200},
     "overlap":        {"tpu": 600,  "rehearse": 600},
+    # hierarchical-vs-flat race (round 11): per-fabric byte + timing
+    # rows on the hybrid mesh; cheap, slotted right after overlap
+    "hier":           {"tpu": 300,  "rehearse": 300},
     "bisect":         {"tpu": 1200, "rehearse": 900},
     "breakdown":      {"tpu": 900,  "rehearse": 700},
     "diag":           {"tpu": 900,  "rehearse": 700},

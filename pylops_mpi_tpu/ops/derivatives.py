@@ -124,10 +124,23 @@ class _StencilOperator(MPILinearOperator):
     ghost ``ppermute``\\ s are issued first and consumed ONLY by the
     ``w``-row boundary patches, so the interior stencil — the bulk of
     the FLOPs — carries no dependence on the exchange and runs while
-    the slabs fly (round 8; see :meth:`_apply_explicit`)."""
+    the slabs fly (round 8; see :meth:`_apply_explicit`).
 
-    def __init__(self, dims, mesh=None, dtype=None, overlap=None):
-        from ..utils.deps import overlap_enabled
+    ``hierarchical`` (``PYLOPS_MPI_TPU_HIERARCHICAL``, round 11): on a
+    hybrid mesh (``make_mesh_hybrid``) the explicit stencil kernels are
+    normally unavailable (they index a flat rank grid over ONE mesh
+    axis) and the operator silently takes the implicit GSPMD path. With
+    hierarchical enabled the kernels run over the axis TUPLE instead —
+    the rank is linearized row-major across the axes, each ghost
+    exchange stays the same single neighbour ``ppermute`` (already
+    staged: only the slice-boundary pair crosses DCN), and the ghost
+    byte counters split per fabric via ``topology.slice_map``. Results
+    are bit-identical to the flat-mesh kernels (pure data movement plus
+    the same local stencil); ``off`` keeps the implicit fallback."""
+
+    def __init__(self, dims, mesh=None, dtype=None, overlap=None,
+                 hierarchical=None):
+        from ..utils.deps import overlap_enabled, hierarchical_enabled
         self.dims_nd = _tuplize(dims)
         n = int(np.prod(self.dims_nd))
         from ..parallel.mesh import default_mesh
@@ -145,6 +158,20 @@ class _StencilOperator(MPILinearOperator):
                     and tplan.get("overlap") in ("on", "off"):
                 overlap = tplan.get("overlap")
         self._overlap = overlap_enabled(overlap)
+        # explicit-stencil mesh-axis handling (round 11): a single axis
+        # name on a 1-D mesh; the full axis tuple (rank linearized
+        # row-major) on a hybrid mesh with hierarchical enabled; None —
+        # explicit path unavailable, implicit GSPMD fallback — on any
+        # other multi-axis mesh (bit-identical to pre-round-11)
+        from ..parallel import topology as _topo
+        self._slice_map = _topo.slice_map(self.mesh)
+        if len(self.mesh.axis_names) == 1:
+            self._axes = self.mesh.axis_names[0]
+        elif _topo.hybrid_axes(self.mesh) is not None \
+                and hierarchical_enabled(hierarchical):
+            self._axes = tuple(self.mesh.axis_names)
+        else:
+            self._axes = None
         # output local shapes: balanced row split of axis 0, flattened
         # (what the reference's @reshaped produces)
         rows = local_split(self.dims_nd, int(self.mesh.devices.size),
@@ -199,7 +226,7 @@ class _StencilOperator(MPILinearOperator):
         spec = _stencil_spec(op)
         if spec is None:
             return None
-        if len(self.mesh.axis_names) != 1:  # 1-D ring schedule only
+        if self._axes is None:  # multi-axis mesh, no hierarchical route
             return None
         P_ = int(self.mesh.devices.size)
         dims = self.dims_nd
@@ -225,7 +252,20 @@ class _StencilOperator(MPILinearOperator):
 
         rmax = max(rows_tab)
         ragged = len(set(rows_tab)) > 1
-        axis_name = self.mesh.axis_names[0]
+        axis_name = self._axes
+        slice_map = self._slice_map
+        # linearized rank inside the kernel: plain axis_index on a 1-D
+        # mesh, explicit row-major combination on a hybrid axis tuple
+        # (the tuple form of lax.axis_index is not relied on)
+        mesh_shape = np.asarray(self.mesh.devices).shape
+
+        def flat_rank():
+            if isinstance(axis_name, str):
+                return lax.axis_index(axis_name)
+            r = lax.axis_index(axis_name[0])
+            for nm, sz in zip(axis_name[1:], mesh_shape[1:]):
+                r = r * int(sz) + lax.axis_index(nm)
+            return r
         n0 = dims[0]
         lo_z, hi_z = spec["lo_z"], spec["hi_z"]
         taps = (spec["taps"] if forward
@@ -259,7 +299,7 @@ class _StencilOperator(MPILinearOperator):
 
         def kernel(xb):
             b = xb.reshape((rmax,) + tuple(dims[1:]))
-            idx = lax.axis_index(axis_name)
+            idx = flat_rank()
             valid = jnp.take(valid_tab, idx)
             row = lax.broadcasted_iota(jnp.int32, b.shape, 0)
             G = jnp.take(base_tab, idx) + row  # global row index
@@ -273,7 +313,8 @@ class _StencilOperator(MPILinearOperator):
             if use_overlap:
                 from ..parallel.collectives import ring_halo_ghosts
                 # ghosts first: consumed only by the boundary patches
-                gf, gb = ring_halo_ghosts(b, axis_name, P_, w, w, valid)
+                gf, gb = ring_halo_ghosts(b, axis_name, P_, w, w, valid,
+                                          slice_map=slice_map)
                 # interior: the zero-extended local slab — exact
                 # everywhere except the first/last w valid rows
                 padw = [(w, w)] + [(0, 0)] * (b.ndim - 1)
@@ -306,7 +347,7 @@ class _StencilOperator(MPILinearOperator):
                     y, tap_rows(bot_in, w), valid - w, axis=0)
             else:
                 slab = halo_slab(b, axis_name, P_, 0, w, w, valid, rmax,
-                                 ragged)
+                                 ragged, slice_map=slice_map)
                 if pallas_core is not None:
                     y = pallas_core(slab)
                 else:
@@ -354,8 +395,9 @@ class MPIFirstDerivative(_StencilOperator):
 
     def __init__(self, dims, sampling: float = 1.0, kind: str = "centered",
                  edge: bool = False, order: int = 3, mesh=None,
-                 dtype=np.float64, overlap=None):
-        super().__init__(dims, mesh=mesh, dtype=dtype, overlap=overlap)
+                 dtype=np.float64, overlap=None, hierarchical=None):
+        super().__init__(dims, mesh=mesh, dtype=dtype, overlap=overlap,
+                         hierarchical=hierarchical)
         self.sampling = sampling
         self.kind = kind
         self.edge = edge
@@ -380,8 +422,9 @@ class MPISecondDerivative(_StencilOperator):
 
     def __init__(self, dims, sampling: float = 1.0, kind: str = "centered",
                  edge: bool = False, mesh=None, dtype=np.float64,
-                 overlap=None):
-        super().__init__(dims, mesh=mesh, dtype=dtype, overlap=overlap)
+                 overlap=None, hierarchical=None):
+        super().__init__(dims, mesh=mesh, dtype=dtype, overlap=overlap,
+                         hierarchical=hierarchical)
         self.sampling = sampling
         self.kind = kind
         self.edge = edge
@@ -438,7 +481,7 @@ class MPIGradient(MPILinearOperator):
 
     def __init__(self, dims, sampling=1, kind: str = "centered",
                  edge: bool = False, mesh=None, dtype=np.float64,
-                 overlap=None):
+                 overlap=None, hierarchical=None):
         self.dims_nd = _tuplize(dims)
         ndims = len(self.dims_nd)
         # NOT _tuplize: sampling is a float spacing, an int cast would
@@ -457,7 +500,8 @@ class MPIGradient(MPILinearOperator):
             op = _AxisFirstDerivative(self.dims_nd, axis=ax,
                                       sampling=sampling[ax], kind=kind,
                                       edge=edge, mesh=mesh, dtype=dtype,
-                                      overlap=overlap)
+                                      overlap=overlap,
+                                      hierarchical=hierarchical)
             grad_ops.append(op)
         stack = MPIStackedVStack(grad_ops)
         super().__init__(shape=stack.shape, dtype=np.dtype(dtype))
@@ -477,8 +521,9 @@ class _AxisFirstDerivative(_StencilOperator):
     inside MPIBlockDiag, ref ``Gradient.py:88-97``)."""
 
     def __init__(self, dims, axis, sampling, kind, edge, mesh=None,
-                 dtype=np.float64, overlap=None):
-        super().__init__(dims, mesh=mesh, dtype=dtype, overlap=overlap)
+                 dtype=np.float64, overlap=None, hierarchical=None):
+        super().__init__(dims, mesh=mesh, dtype=dtype, overlap=overlap,
+                         hierarchical=hierarchical)
         self._op = _LocalFirst(self.dims_nd, axis=axis, sampling=sampling,
                                kind=kind, edge=edge, dtype=dtype)
 

@@ -45,6 +45,20 @@ transform section, so chunk ``k``'s ICI transfer flies while chunk
 transpose are pinned in CI; ``off`` keeps the bulk single-collective
 kernels bit-identical, and chunk counts that don't fit the axis fall
 back with a logged note.
+
+Hierarchical pencil transposes (round 11,
+``PYLOPS_MPI_TPU_HIERARCHICAL`` / ``hierarchical=``): on a HYBRID mesh
+(``make_mesh_hybrid`` — a DCN axis over slices times an ICI axis
+within each; ``parallel/topology.py``) the aligned pencil path opens
+up and every transpose runs the two-level schedule
+(``collectives.hier_pencil_transpose``): a local block reorder, the
+dense intra-slice all-to-all on the ICI axis, and ONE staged
+inter-slice exchange on the DCN axis — bit-identical in result to the
+flat combined-axis all-to-all, but each device's DCN traffic drops to
+the direct ``(D-1)/D`` share of its shard instead of the full-gather
+volume the generic multi-axis reshard pays (the ``_reshard``
+note below). ``off`` keeps hybrid meshes on the pre-round-11 generic
+path, compiled-HLO bit-identical (pinned); flat meshes never change.
 """
 
 from __future__ import annotations
@@ -83,7 +97,7 @@ class _MPIBaseFFTND(MPILinearOperator):
     def __init__(self, dims, axes, nffts=None, sampling=1.0, norm="none",
                  real=False, ifftshift_before=False, fftshift_after=False,
                  mesh=None, dtype="complex128", overlap=None,
-                 comm_chunks=None):
+                 comm_chunks=None, hierarchical=None):
         if comm_chunks is not None and int(comm_chunks) < 1:
             raise ValueError(f"comm_chunks={comm_chunks}: must be >= 1")
         self.dims_nd = tuple(int(d) for d in np.atleast_1d(dims))
@@ -150,11 +164,15 @@ class _MPIBaseFFTND(MPILinearOperator):
         # env seams behave exactly as before when tuning is off.
         from ..utils.deps import (overlap_enabled, comm_chunks_default,
                                   overlap_env_pinned,
-                                  comm_chunks_env_pinned)
+                                  comm_chunks_env_pinned,
+                                  hierarchical_enabled,
+                                  hierarchical_env_pinned)
         want_overlap = overlap is None and not overlap_env_pinned()
         want_chunks = comm_chunks is None and not comm_chunks_env_pinned()
+        want_hier = (hierarchical is None
+                     and not hierarchical_env_pinned())
         self._chunks_from_user = not want_chunks
-        if want_overlap or want_chunks:
+        if want_overlap or want_chunks or want_hier:
             from ..tuning import plan as _tuneplan
             tplan = _tuneplan.get_plan(
                 "fft", shape=self.dims_nd, dtype=self.cdtype,
@@ -167,9 +185,27 @@ class _MPIBaseFFTND(MPILinearOperator):
                     overlap = tplan.get("overlap")
                 if want_chunks and tplan.get("comm_chunks"):
                     comm_chunks = max(1, int(tplan.get("comm_chunks")))
+                if want_hier and tplan.get("hierarchical") in (
+                        "auto", "on", "off"):
+                    hierarchical = tplan.get("hierarchical")
         self._overlap = overlap_enabled(overlap)
         self._comm_chunks = (int(comm_chunks) if comm_chunks is not None
                              else comm_chunks_default())
+        # hierarchical pencil transposes (round 11): active only on a
+        # hybrid mesh whose >1-sized axes are exactly (dcn, ici) in
+        # mesh order — the linearization hier_pencil_transpose's block
+        # reorder is paired against. Off (or any flat mesh) keeps the
+        # pre-round-11 paths untouched.
+        from ..parallel import topology as _topo
+        _h = _topo.hybrid_axes(self.mesh)
+        use_hier = _h is not None and hierarchical_enabled(hierarchical)
+        if use_hier:
+            devshape = np.asarray(self.mesh.devices).shape
+            big = [str(n) for n, s in zip(self.mesh.axis_names, devshape)
+                   if int(s) > 1]
+            use_hier = big == [_h[0], _h[1]]
+        self._hier_shape = _h if use_hier else None
+        self._hier = use_hier
         self.dims = self.dims_nd
         self.dimsd = self.dimsd_nd
         super().__init__(shape=(int(np.prod(dimsd)), int(np.prod(self.dims_nd))),
@@ -345,6 +381,16 @@ class _MPIBaseFFTND(MPILinearOperator):
         y._arr = y._place(phys.astype(dtype))
         return y
 
+    def _pencil_layout(self):
+        """``(axis_name, hier)`` for the aligned kernels: the single
+        mesh axis name and ``None`` on a flat mesh; the full axis-name
+        tuple (flat buffers shard over every mesh axis) plus the
+        ``(dcn_axis, ici_axis, D, I)`` decomposition when the
+        hierarchical schedule is active (round 11)."""
+        if self._hier_shape is not None:
+            return tuple(self.mesh.axis_names), self._hier_shape
+        return self.mesh.axis_names[0], None
+
     @staticmethod
     def _block_transpose(b: jax.Array, axis_name: str, P: int,
                          out_ax: int) -> jax.Array:
@@ -362,13 +408,44 @@ class _MPIBaseFFTND(MPILinearOperator):
                                concat_axis=0, tiled=True)
         return b
 
+    @staticmethod
+    def _block_transpose_hier(b: jax.Array, hier, out_ax: int) -> jax.Array:
+        """Hybrid-mesh :meth:`_block_transpose`: pad ``out_ax`` to a
+        device multiple, then the two-level transpose (local reorder +
+        intra-slice ICI all-to-all + ONE staged DCN exchange) — result
+        bit-identical to the flat combined-axis all-to-all."""
+        from ..parallel.collectives import hier_pencil_transpose
+        P = int(hier[2]) * int(hier[3])
+        bo = -(-b.shape[out_ax] // P)
+        tail = P * bo - b.shape[out_ax]
+        if tail:
+            padw = [(0, 0)] * b.ndim
+            padw[out_ax] = (0, tail)
+            b = jnp.pad(b, padw)
+        return hier_pencil_transpose(b, *hier, out_ax, forward=True)
+
+    @staticmethod
+    def _block_transpose_planes_hier(br, bi, hier, out_ax: int):
+        """Planar :meth:`_block_transpose_hier` (one stacked real
+        collective per fabric phase)."""
+        from ..parallel.collectives import hier_pencil_transpose_planes
+        P = int(hier[2]) * int(hier[3])
+        bo = -(-br.shape[out_ax] // P)
+        tail = P * bo - br.shape[out_ax]
+        if tail:
+            padw = [(0, 0)] * br.ndim
+            padw[out_ax] = (0, tail)
+            br, bi = jnp.pad(br, padw), jnp.pad(bi, padw)
+        return hier_pencil_transpose_planes(br, bi, *hier, out_ax,
+                                            forward=True)
+
     # --------------------------------------------------------------- apply
     def _matvec(self, x: DistributedArray) -> DistributedArray:
         if x.partition != Partition.SCATTER:
             raise ValueError(f"x should have partition={Partition.SCATTER}"
                              f" Got {x.partition} instead...")
         if (len(self.dims_nd) > 1 and self._in_axis == 0
-                and len(self.mesh.axis_names) == 1):
+                and (len(self.mesh.axis_names) == 1 or self._hier)):
             return self._matvec_aligned(x)
         return self._matvec_generic(x)
 
@@ -377,7 +454,7 @@ class _MPIBaseFFTND(MPILinearOperator):
             raise ValueError(f"x should have partition={Partition.SCATTER}"
                              f" Got {x.partition} instead...")
         if (len(self.dims_nd) > 1 and self._in_axis == 0
-                and len(self.mesh.axis_names) == 1):
+                and (len(self.mesh.axis_names) == 1 or self._hier)):
             return self._rmatvec_aligned(x)
         return self._rmatvec_generic(x)
 
@@ -394,7 +471,16 @@ class _MPIBaseFFTND(MPILinearOperator):
         shift_before = self._shift_axes(self.ifftshift_before)
         shift_after = self._shift_axes(self.fftshift_after)
         P = int(self.mesh.devices.size)
-        axis_name = self.mesh.axis_names[0]
+        axis_name, hier = self._pencil_layout()
+
+        def ridx():
+            # linearized device rank of the flat axis-0 sharding: the
+            # single mesh axis, or dcn-major (d * I + i) on hybrid
+            if hier is None:
+                return lax.axis_index(axis_name)
+            return (lax.axis_index(hier[0]) * hier[3]
+                    + lax.axis_index(hier[1]))
+
         out_ax = self._out_axis
         rows_m, rows_d = self._rows_m, self._rows_d
         rmax_m, rmax_d = max(rows_m), max(rows_d)
@@ -411,7 +497,7 @@ class _MPIBaseFFTND(MPILinearOperator):
 
         def kernel(xb):
             b = xb.reshape((rmax_m,) + tuple(dims[1:]))
-            nrows = rows_m_arr[lax.axis_index(axis_name)]
+            nrows = rows_m_arr[ridx()]
             row = lax.broadcasted_iota(jnp.int32, b.shape, 0)
             b = jnp.where(row < nrows, b, jnp.zeros((), dtype=b.dtype))
             loc_before = [a for a in shift_before if a != 0]
@@ -444,7 +530,19 @@ class _MPIBaseFFTND(MPILinearOperator):
                                      jnp.zeros((), dtype=bb.dtype))
 
                 K = self._pencil_chunks(b.shape[out_ax], P)
-                if K > 1:
+                if hier is not None:
+                    from ..parallel.collectives import (
+                        hier_chunked_pencil_transpose,
+                        hier_pencil_transpose)
+                    if K > 1:
+                        b = hier_chunked_pencil_transpose(
+                            b, *hier, out_ax, K, mid)
+                    else:
+                        b = self._block_transpose_hier(b, hier, out_ax)
+                        b = mid(b)
+                        b = hier_pencil_transpose(b, *hier, out_ax,
+                                                  forward=False)
+                elif K > 1:
                     from ..parallel.collectives import \
                         chunked_pencil_transpose
                     b = chunked_pencil_transpose(b, axis_name, P, out_ax,
@@ -481,7 +579,16 @@ class _MPIBaseFFTND(MPILinearOperator):
         shift_before = self._shift_axes(self.ifftshift_before)
         shift_after = self._shift_axes(self.fftshift_after)
         P = int(self.mesh.devices.size)
-        axis_name = self.mesh.axis_names[0]
+        axis_name, hier = self._pencil_layout()
+
+        def ridx():
+            # linearized device rank of the flat axis-0 sharding: the
+            # single mesh axis, or dcn-major (d * I + i) on hybrid
+            if hier is None:
+                return lax.axis_index(axis_name)
+            return (lax.axis_index(hier[0]) * hier[3]
+                    + lax.axis_index(hier[1]))
+
         out_ax = self._out_axis
         rows_m, rows_d = self._rows_m, self._rows_d
         rmax_m, rmax_d = max(rows_m), max(rows_d)
@@ -495,7 +602,7 @@ class _MPIBaseFFTND(MPILinearOperator):
 
         def kernel(xb):
             b = xb.reshape((rmax_d,) + tuple(dimsd[1:]))
-            nrows = rows_d_arr[lax.axis_index(axis_name)]
+            nrows = rows_d_arr[ridx()]
             row = lax.broadcasted_iota(jnp.int32, b.shape, 0)
             b = jnp.where(row < nrows, b, jnp.zeros((), dtype=b.dtype))
             loc_after = [a for a in shift_after if a != 0]
@@ -518,7 +625,19 @@ class _MPIBaseFFTND(MPILinearOperator):
                                      jnp.zeros((), dtype=bb.dtype))
 
                 K = self._pencil_chunks(b.shape[out_ax], P)
-                if K > 1:
+                if hier is not None:
+                    from ..parallel.collectives import (
+                        hier_chunked_pencil_transpose,
+                        hier_pencil_transpose)
+                    if K > 1:
+                        b = hier_chunked_pencil_transpose(
+                            b, *hier, out_ax, K, mid)
+                    else:
+                        b = self._block_transpose_hier(b, hier, out_ax)
+                        b = mid(b)
+                        b = hier_pencil_transpose(b, *hier, out_ax,
+                                                  forward=False)
+                elif K > 1:
                     from ..parallel.collectives import \
                         chunked_pencil_transpose
                     b = chunked_pencil_transpose(b, axis_name, P, out_ax,
@@ -570,7 +689,7 @@ class _MPIBaseFFTND(MPILinearOperator):
 
     def _planes_path_ok(self) -> bool:
         return (len(self.dims_nd) > 1 and self._in_axis == 0
-                and len(self.mesh.axis_names) == 1)
+                and (len(self.mesh.axis_names) == 1 or self._hier))
 
     @staticmethod
     def _block_transpose_planes(br, bi, axis_name: str, P: int,
@@ -603,7 +722,16 @@ class _MPIBaseFFTND(MPILinearOperator):
         shift_before = self._shift_axes(self.ifftshift_before)
         shift_after = self._shift_axes(self.fftshift_after)
         P = int(self.mesh.devices.size)
-        axis_name = self.mesh.axis_names[0]
+        axis_name, hier = self._pencil_layout()
+
+        def ridx():
+            # linearized device rank of the flat axis-0 sharding: the
+            # single mesh axis, or dcn-major (d * I + i) on hybrid
+            if hier is None:
+                return lax.axis_index(axis_name)
+            return (lax.axis_index(hier[0]) * hier[3]
+                    + lax.axis_index(hier[1]))
+
         out_ax = self._out_axis
         rows_m, rows_d = self._rows_m, self._rows_d
         rmax_m, rmax_d = max(rows_m), max(rows_d)
@@ -621,7 +749,7 @@ class _MPIBaseFFTND(MPILinearOperator):
             br = planes[0].reshape((rmax_m,) + tuple(dims[1:]))
             bi = (planes[1].reshape(br.shape) if len(planes) > 1
                   else None)
-            nrows = rows_m_arr[lax.axis_index(axis_name)]
+            nrows = rows_m_arr[ridx()]
             row = lax.broadcasted_iota(jnp.int32, br.shape, 0)
 
             def scrub(p):
@@ -665,7 +793,20 @@ class _MPIBaseFFTND(MPILinearOperator):
                     return pr_, pi_
 
                 K = self._pencil_chunks(br.shape[out_ax], P)
-                if K > 1:
+                if hier is not None:
+                    from ..parallel.collectives import (
+                        hier_chunked_pencil_transpose_planes,
+                        hier_pencil_transpose_planes)
+                    if K > 1:
+                        br, bi = hier_chunked_pencil_transpose_planes(
+                            br, bi, *hier, out_ax, K, mid)
+                    else:
+                        br, bi = self._block_transpose_planes_hier(
+                            br, bi, hier, out_ax)
+                        br, bi = mid(br, bi)
+                        br, bi = hier_pencil_transpose_planes(
+                            br, bi, *hier, out_ax, forward=False)
+                elif K > 1:
                     from ..parallel.collectives import \
                         chunked_pencil_transpose_planes
                     br, bi = chunked_pencil_transpose_planes(
@@ -710,7 +851,16 @@ class _MPIBaseFFTND(MPILinearOperator):
         shift_before = self._shift_axes(self.ifftshift_before)
         shift_after = self._shift_axes(self.fftshift_after)
         P = int(self.mesh.devices.size)
-        axis_name = self.mesh.axis_names[0]
+        axis_name, hier = self._pencil_layout()
+
+        def ridx():
+            # linearized device rank of the flat axis-0 sharding: the
+            # single mesh axis, or dcn-major (d * I + i) on hybrid
+            if hier is None:
+                return lax.axis_index(axis_name)
+            return (lax.axis_index(hier[0]) * hier[3]
+                    + lax.axis_index(hier[1]))
+
         out_ax = self._out_axis
         rows_m, rows_d = self._rows_m, self._rows_d
         rmax_m, rmax_d = max(rows_m), max(rows_d)
@@ -728,7 +878,7 @@ class _MPIBaseFFTND(MPILinearOperator):
             br = planes[0].reshape((rmax_d,) + tuple(dimsd[1:]))
             bi = (planes[1].reshape(br.shape) if len(planes) > 1
                   else None)
-            nrows = rows_d_arr[lax.axis_index(axis_name)]
+            nrows = rows_d_arr[ridx()]
             row = lax.broadcasted_iota(jnp.int32, br.shape, 0)
 
             def scrub(p):
@@ -769,7 +919,20 @@ class _MPIBaseFFTND(MPILinearOperator):
                     return pr_, pi_
 
                 K = self._pencil_chunks(br.shape[out_ax], P)
-                if K > 1:
+                if hier is not None:
+                    from ..parallel.collectives import (
+                        hier_chunked_pencil_transpose_planes,
+                        hier_pencil_transpose_planes)
+                    if K > 1:
+                        br, bi = hier_chunked_pencil_transpose_planes(
+                            br, bi, *hier, out_ax, K, mid)
+                    else:
+                        br, bi = self._block_transpose_planes_hier(
+                            br, bi, hier, out_ax)
+                        br, bi = mid(br, bi)
+                        br, bi = hier_pencil_transpose_planes(
+                            br, bi, *hier, out_ax, forward=False)
+                elif K > 1:
                     from ..parallel.collectives import \
                         chunked_pencil_transpose_planes
                     br, bi = chunked_pencil_transpose_planes(
@@ -912,7 +1075,8 @@ class _MPIBaseFFTND(MPILinearOperator):
         if not self._planes_path_ok():
             raise NotImplementedError(
                 "plane-pair apply requires the aligned pencil path "
-                "(ndim > 1 with a single-axis mesh and in_axis == 0)")
+                "(ndim > 1 with in_axis == 0 on a single-axis mesh, or "
+                "a hybrid mesh with the hierarchical schedule enabled)")
         for p in (xr, xi):
             if p is None:
                 continue
@@ -1038,13 +1202,14 @@ class MPIFFTND(_MPIBaseFFTND):
     def __init__(self, dims, axes=(0, 1, 2), nffts=None, sampling=1.0,
                  norm="none", real=False, ifftshift_before=False,
                  fftshift_after=False, mesh=None, dtype="complex128",
-                 overlap=None, comm_chunks=None):
+                 overlap=None, comm_chunks=None, hierarchical=None):
         super().__init__(dims=dims, axes=axes, nffts=nffts, sampling=sampling,
                          norm=norm, real=real,
                          ifftshift_before=ifftshift_before,
                          fftshift_after=fftshift_after, mesh=mesh,
                          dtype=dtype, overlap=overlap,
-                         comm_chunks=comm_chunks)
+                         comm_chunks=comm_chunks,
+                         hierarchical=hierarchical)
 
 
 class MPIFFT2D(_MPIBaseFFTND):
@@ -1053,7 +1218,7 @@ class MPIFFT2D(_MPIBaseFFTND):
     def __init__(self, dims, axes=(0, 1), nffts=None, sampling=1.0,
                  norm="none", real=False, ifftshift_before=False,
                  fftshift_after=False, mesh=None, dtype="complex128",
-                 overlap=None, comm_chunks=None):
+                 overlap=None, comm_chunks=None, hierarchical=None):
         if len(np.atleast_1d(axes)) != 2:
             raise ValueError("MPIFFT2D requires exactly two axes")
         super().__init__(dims=dims, axes=axes, nffts=nffts, sampling=sampling,
@@ -1061,7 +1226,8 @@ class MPIFFT2D(_MPIBaseFFTND):
                          ifftshift_before=ifftshift_before,
                          fftshift_after=fftshift_after, mesh=mesh,
                          dtype=dtype, overlap=overlap,
-                         comm_chunks=comm_chunks)
+                         comm_chunks=comm_chunks,
+                         hierarchical=hierarchical)
 
 
 # array-less pytree registration (shift/scale factors are rebuilt from

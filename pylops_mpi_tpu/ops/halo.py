@@ -88,11 +88,20 @@ class MPIHalo(MPILinearOperator):
     boundary slabs fly. ``off`` keeps the single post-exchange gather
     bit-identical; results are equal either way (the extended block's
     interior IS the block).
+
+    ``hierarchical`` (``PYLOPS_MPI_TPU_HIERARCHICAL``, round 11): on a
+    hybrid (multi-slice) mesh the kernels run over the tuple of mesh
+    axes — the flat Cartesian rank grid linearizes row-major over
+    (dcn, ici), so slab ``ppermute``\\ s between same-slice neighbours
+    stay on ICI and only the slice-boundary pairs cross DCN, with the
+    per-fabric byte split stamped on the ``cart_halo_extend`` counters.
+    With ``hierarchical`` off a multi-axis mesh keeps raising (the
+    pre-round-11 contract).
     """
 
     def __init__(self, dims, halo, proc_grid_shape=None, mesh=None,
-                 dtype=np.float64, overlap=None):
-        from ..utils.deps import overlap_enabled
+                 dtype=np.float64, overlap=None, hierarchical=None):
+        from ..utils.deps import overlap_enabled, hierarchical_enabled
         self.global_dims = tuple(int(d) for d in np.atleast_1d(dims))
         self.ndim = len(self.global_dims)
         from ..parallel.mesh import default_mesh
@@ -109,11 +118,24 @@ class MPIHalo(MPILinearOperator):
                     and tplan.get("overlap") in ("on", "off"):
                 overlap = tplan.get("overlap")
         self._overlap = overlap_enabled(overlap)
+        # mesh axes the kernels dispatch over: the single axis name on
+        # a 1-D mesh (pre-round-11, unchanged), or the tuple of axis
+        # names on a hybrid mesh with hierarchical enabled — ranks
+        # linearize row-major over the tuple, matching PartitionSpec
+        from ..parallel import topology as _topo
+        self._axes = self.mesh.axis_names[0]
+        self._slice_map = _topo.slice_map(self.mesh)
         if len(self.mesh.axis_names) != 1:
-            raise ValueError(
-                "MPIHalo requires a single-axis (1-D) mesh: its shard_map "
-                "kernels index the flat Cartesian rank grid over one mesh "
-                "axis; flatten the hybrid mesh or pass make_mesh()")
+            if _topo.hybrid_axes(self.mesh) is not None \
+                    and hierarchical_enabled(hierarchical):
+                self._axes = tuple(self.mesh.axis_names)
+            else:
+                raise ValueError(
+                    "MPIHalo requires a single-axis (1-D) mesh: its "
+                    "shard_map kernels index the flat Cartesian rank grid "
+                    "over one mesh axis; flatten the hybrid mesh, pass "
+                    "make_mesh(), or enable hierarchical=True / "
+                    "PYLOPS_MPI_TPU_HIERARCHICAL=on on a hybrid mesh")
         P_ = int(self.mesh.devices.size)
         if proc_grid_shape is None:
             proc_grid_shape = (1,) * (self.ndim - 1) + (P_,)
@@ -215,6 +237,20 @@ class MPIHalo(MPILinearOperator):
                         "neighbour block size")
 
     # ------------------------------------------------------------- apply
+    def _flat_rank(self):
+        """Linearized rank inside the shard_map kernel: the plain
+        ``axis_index`` on a 1-D mesh, or the row-major combination over
+        the axis tuple on a hybrid mesh (computed explicitly — the
+        tuple form of ``lax.axis_index`` is not relied on)."""
+        if isinstance(self._axes, str):
+            return lax.axis_index(self._axes)
+        sizes = dict(zip(self.mesh.axis_names,
+                         np.asarray(self.mesh.devices).shape))
+        r = lax.axis_index(self._axes[0])
+        for nm in self._axes[1:]:
+            r = r * int(sizes[nm]) + lax.axis_index(nm)
+        return r
+
     @staticmethod
     def _c_strides(dims) -> list:
         """Traced C-order strides of a block whose per-axis lengths are
@@ -250,7 +286,8 @@ class MPIHalo(MPILinearOperator):
             raise ValueError(
                 "MPIHalo input local shapes do not match the Cartesian "
                 "block decomposition")
-        axis_name = self.mesh.axis_names[0]
+        axis_name = self._axes
+        slice_map = self._slice_map
         base, grid, ndim = self._base_halo, self.proc_grid_shape, self.ndim
         ld_tab = jnp.asarray(self._ld_tab)
         ext_tab = jnp.asarray(self._ext_tab)
@@ -266,7 +303,7 @@ class MPIHalo(MPILinearOperator):
         use_overlap = self._overlap and exchanges
 
         def kernel(xs):
-            r = lax.axis_index(axis_name)
+            r = self._flat_rank()
             ld = jnp.take(ld_tab, r, axis=0)                  # (ndim,)
             blk0 = self._unpack_block(xs, ld)
             # sequential per-axis neighbour exchange: boundary slabs
@@ -275,7 +312,7 @@ class MPIHalo(MPILinearOperator):
             for ax in range(ndim):
                 blk = cart_halo_extend(blk, axis_name, grid, ax,
                                        base[2 * ax], base[2 * ax + 1],
-                                       ld[ax])
+                                       ld[ax], slice_map=slice_map)
             # repack this rank's logical haloed window (a traced-offset
             # sub-box of the full-width extended block) to the padded
             # flat output shard — second computed gather
@@ -340,7 +377,7 @@ class MPIHalo(MPILinearOperator):
             raise ValueError(
                 "MPIHalo adjoint input local shapes do not match the "
                 "haloed decomposition")
-        axis_name = self.mesh.axis_names[0]
+        axis_name = self._axes
         ndim = self.ndim
         ld_tab = jnp.asarray(self._ld_tab)
         ext_tab = jnp.asarray(self._ext_tab)
@@ -348,7 +385,7 @@ class MPIHalo(MPILinearOperator):
         sp_in = self._sp_in
 
         def kernel(xs):
-            r = lax.axis_index(axis_name)
+            r = self._flat_rank()
             ld = jnp.take(ld_tab, r, axis=0)
             ext = jnp.take(ext_tab, r, axis=0)
             hm = jnp.take(hm_tab, r, axis=0)

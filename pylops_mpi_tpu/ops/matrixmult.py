@@ -268,14 +268,27 @@ class _MPISummaMatrixMult(_MatMulBase):
     ``overlap=off`` (the default off-TPU) keeps the bulk kernels
     bit-identical; ``on`` reorders the floating-point accumulation
     (per-block partial sums) and matches within dtype tolerance.
+
+    ``hierarchical`` (``PYLOPS_MPI_TPU_HIERARCHICAL``, round 11): on a
+    hybrid mesh the (r, c) grid inherits the base mesh's dcn-major
+    device order, so an aligned grid (the 8-device default: r spans
+    slices, c stays inside one) already keeps the hot ``c``-axis
+    collectives on ICI — enabling ``hierarchical`` activates the
+    fabric-aligned cost/byte attribution (``_hier``) and, when the
+    ``c`` axis DOES span slices (e.g. a ``(1, P)`` grid), switches the
+    ring kernels to the two-level hop schedule
+    (:func:`~pylops_mpi_tpu.parallel.collectives.ring_pass` with
+    ``slice_size``): inner hops rotate within a slice on ICI and only
+    one hop per inner lap crosses DCN. ``off`` keeps every kernel
+    bit-identical to the flat build.
     """
 
     _uses_At = False
 
     def __init__(self, A, M: int, mesh=None, dtype=None, saveAt: bool = False,
                  grid: Optional[Tuple[int, int]] = None, compute_dtype=None,
-                 schedule: str = "auto", overlap=None):
-        from ..utils.deps import overlap_enabled
+                 schedule: str = "auto", overlap=None, hierarchical=None):
+        from ..utils.deps import overlap_enabled, hierarchical_enabled
         base = mesh if mesh is not None else default_mesh()
         ndev = int(base.devices.size)
         self.grid = grid if grid is not None else best_grid_2d(ndev)
@@ -283,21 +296,45 @@ class _MPISummaMatrixMult(_MatMulBase):
             raise ValueError(f"schedule={schedule!r}: expected "
                              "'auto', 'gather' or 'stat_a'")
         # autotuner seam (round 10): fill ONLY the knobs left at their
-        # sentinels (schedule="auto" / overlap=None) from the plan —
-        # explicit kwargs AND explicit env pins (PYLOPS_MPI_TPU_OVERLAP
-        # = on|off) always beat the tuner; PYLOPS_MPI_TPU_TUNE=off
-        # returns None here and everything below is untouched
-        from ..utils.deps import overlap_env_pinned
+        # sentinels (schedule="auto" / overlap=None / hierarchical=None)
+        # from the plan — explicit kwargs AND explicit env pins
+        # (PYLOPS_MPI_TPU_OVERLAP / _HIERARCHICAL = on|off) always beat
+        # the tuner; PYLOPS_MPI_TPU_TUNE=off returns None here and
+        # everything below is untouched
+        from ..utils.deps import overlap_env_pinned, hierarchical_env_pinned
         want_overlap = overlap is None and not overlap_env_pinned()
+        want_hier = hierarchical is None and not hierarchical_env_pinned()
         tplan = None
-        if schedule == "auto" or want_overlap:
+        if schedule == "auto" or want_overlap or want_hier:
             tplan = self._consult_plan(A, M, base, dtype,
                                        compute_dtype)
         if want_overlap and tplan is not None \
                 and tplan.get("overlap") in ("on", "off"):
             overlap = tplan.get("overlap")
+        if want_hier and tplan is not None \
+                and tplan.get("hierarchical") in ("auto", "on", "off"):
+            hierarchical = tplan.get("hierarchical")
         self.overlap = overlap_enabled(overlap)
         self.mesh2 = Mesh(base.devices.reshape(self.grid), ("r", "c"))
+        # fabric classification of the 2-D grid (round 11): `_hier`
+        # turns on the per-fabric cost/byte attribution; `_ring_slice`
+        # is non-None only when the ring axis 'c' spans slices in
+        # contiguous blocks — the shape the two-level hop schedule
+        # stages. Both stay False/None on flat meshes and under
+        # hierarchical=off, keeping the kernels (and their HLO)
+        # untouched.
+        from ..parallel import topology as _topo
+        self._hier = False
+        self._ring_slice = None
+        self._fab_c = None
+        fr = _topo.axis_fabric(self.mesh2, "r")
+        fc = _topo.axis_fabric(self.mesh2, "c")
+        if "dcn" in (fr, fc):  # multi-slice device set (not plain flat)
+            self._fab_c = fc
+            if hierarchical_enabled(hierarchical):
+                self._hier = True
+                if fc == "dcn":
+                    self._ring_slice = _topo.slice_run(self.mesh2, "c")
         super().__init__(A, M, mesh=base, dtype=dtype, saveAt=saveAt,
                          compute_dtype=compute_dtype)
         pr, pc = self.grid
@@ -364,7 +401,8 @@ class _MPISummaMatrixMult(_MatMulBase):
             op = _MPISummaMatrixMult(
                 A, M, mesh=base, dtype=dtype, saveAt=False,
                 grid=self.grid, compute_dtype=compute_dtype,
-                schedule=params["schedule"], overlap=params["overlap"])
+                schedule=params["schedule"], overlap=params["overlap"],
+                hierarchical=params.get("hierarchical"))
             x = np.zeros(K_ * int(M), dtype=op.dtype)
             dx = DistributedArray.to_dist(x, mesh=base)
             return lambda: jax.block_until_ready(op.matvec(dx).array)
@@ -431,14 +469,18 @@ class _MPISummaMatrixMult(_MatMulBase):
             part = self._gemm(Ares, Xk)
             return part if acc is None else acc + part
 
-        return ring_pass(Ablk, "c", pc, body)
+        return ring_pass(Ablk, "c", pc, body, slice_size=self._ring_slice,
+                         fabric=self._fab_c)
 
     def _kernel_fwd_stat_a_ring(self, Ablk, Xblk):
         # ring reduce-scatter form of stationary-A: A still never
         # moves; the bulk psum_scatter becomes pc-1 accumulator hops
         # along 'c', and the partial GEMM for each output M-chunk is
         # computed just-in-time at its hop so the chunk transfer hides
-        # behind the next chunk's GEMM.
+        # behind the next chunk's GEMM. (No hierarchical variant
+        # needed: every hop is a neighbour shift, so on a slice-blocked
+        # 'c' axis only the block-boundary pairs ever cross DCN — the
+        # schedule is already staged by construction.)
         pc = self.grid[1]
         Xfull = lax.all_gather(Xblk, "r", axis=0, tiled=True)
         Xfull = lax.all_gather(Xfull, "c", axis=1, tiled=True)  # (Kp_r, Mp)
@@ -475,13 +517,32 @@ class _MPISummaMatrixMult(_MatMulBase):
         mb = Yblk.shape[1]  # = Mp_eff // pc; block inputs widen Mp
         c = lax.axis_index("c")
         At = jnp.conj(Ablk).T
+        if self._ring_slice:
+            # hierarchical hop order visits owners out of rotation
+            # sequence, so the concatenate-then-roll trick below (which
+            # assumes owners c, c+1, ...) cannot un-rotate it — place
+            # each chunk at its owner's M-column directly instead
+            odt = (self.dtype if self.compute_dtype is not None
+                   else jnp.result_type(At.dtype, Yblk.dtype))
+
+            def body(acc, Yres, owner, _s):
+                part = self._gemm(At, Yres)         # (Kp_c/pc, Mp/pc)
+                return lax.dynamic_update_slice_in_dim(
+                    acc, part.astype(odt), owner * mb, axis=1)
+
+            out = ring_pass(Yblk, "c", pc, body,
+                            init=jnp.zeros((At.shape[0], mb * pc),
+                                           dtype=odt),
+                            slice_size=self._ring_slice,
+                            fabric=self._fab_c)
+            return lax.psum(out, "r")
         parts = []
 
         def body(acc, Yres, _owner, _s):
             parts.append(self._gemm(At, Yres))      # (Kp_c/pc, Mp/pc)
             return acc
 
-        ring_pass(Yblk, "c", pc, body)
+        ring_pass(Yblk, "c", pc, body, fabric=self._fab_c)
         cat = jnp.concatenate(parts, axis=1)        # owners c, c+1, ...
         part = jnp.roll(cat, c * mb, axis=1) if pc > 1 else cat
         return lax.psum(part, "r")
@@ -566,7 +627,7 @@ def MPIMatrixMult(A, M: int, saveAt: bool = False, mesh=None,
                   grid: Optional[Tuple[int, int]] = None,
                   compute_dtype=None,
                   schedule: str = "auto",
-                  overlap=None) -> MPILinearOperator:
+                  overlap=None, hierarchical=None) -> MPILinearOperator:
     """Factory (ref ``MatrixMult.py:768-872``): ``kind`` in
     {"block", "summa", "auto"}.
 
@@ -585,7 +646,14 @@ def MPIMatrixMult(A, M: int, saveAt: bool = False, mesh=None,
     ``off`` is bit-identical to the bulk schedules, ``on`` matches
     within dtype tolerance (the accumulation order changes). ``block``
     and ``auto`` kinds ignore it (forward is comm-free / the
-    partitioner owns the schedule).
+    partitioner owns the schedule). ``hierarchical`` (summa only;
+    ``True``/``False``/``"auto"``, default the
+    ``PYLOPS_MPI_TPU_HIERARCHICAL`` env seam) enables the
+    topology-aware treatment on hybrid (multi-slice) meshes:
+    fabric-aligned per-fabric cost/byte accounting, and the two-level
+    ring hop schedule when the grid's ``c`` axis spans slices — see
+    ``_MPISummaMatrixMult``. ``off`` (and any flat mesh) keeps the
+    kernels bit-identical to the pre-hierarchical build.
     """
     if kind == "block":
         return _MPIBlockMatrixMult(A, M, mesh=mesh, dtype=dtype,
@@ -594,7 +662,8 @@ def MPIMatrixMult(A, M: int, saveAt: bool = False, mesh=None,
         return _MPISummaMatrixMult(A, M, mesh=mesh, dtype=dtype,
                                    saveAt=saveAt, grid=grid,
                                    compute_dtype=compute_dtype,
-                                   schedule=schedule, overlap=overlap)
+                                   schedule=schedule, overlap=overlap,
+                                   hierarchical=hierarchical)
     if kind == "auto":
         return _MPIAutoMatrixMult(A, M, mesh=mesh, dtype=dtype,
                                   saveAt=saveAt, grid=grid,

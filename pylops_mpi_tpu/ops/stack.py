@@ -48,12 +48,23 @@ class MPIVStack(MPILinearOperator):
     all-gather to restore the BROADCAST result), so each hop's ICI
     transfer hides behind the next chunk's MXU work. ``off`` keeps the
     einsum-then-psum path bit-identical.
+
+    ``hierarchical`` (``PYLOPS_MPI_TPU_HIERARCHICAL``, round 11): the
+    ring form above assumes a single mesh axis, so on a hybrid
+    (multi-slice) mesh ``overlap`` instead selects the two-level
+    reduction — per-device partial GEMM, then the hierarchical
+    reduce-scatter / all-gather pair
+    (:func:`~pylops_mpi_tpu.parallel.collectives.hier_psum_scatter` /
+    ``hier_all_gather``): the inner ICI stage shrinks the payload
+    ``P_ici``-fold before anything touches DCN. With ``hierarchical``
+    off a hybrid mesh keeps the bulk einsum-then-psum path.
     """
 
     def __init__(self, ops: Sequence[LocalOperator],
                  mask: Optional[Sequence[int]] = None,
-                 mesh=None, dtype=None, compute_dtype=None, overlap=None):
-        from ..utils.deps import overlap_enabled
+                 mesh=None, dtype=None, compute_dtype=None, overlap=None,
+                 hierarchical=None):
+        from ..utils.deps import overlap_enabled, hierarchical_enabled
         self.ops = list(ops)
         self.mask = tuple(mask) if mask is not None else None
         self.compute_dtype = compute_dtype
@@ -83,6 +94,13 @@ class MPIVStack(MPILinearOperator):
                     and tplan.get("overlap") in ("on", "off"):
                 overlap = tplan.get("overlap")
         self._overlap = overlap_enabled(overlap)
+        # hybrid-mesh classification (round 11): `_hier_shape` names the
+        # (dcn, ici) axes the two-level adjoint reduction stages over;
+        # None on flat meshes and under hierarchical=off
+        from ..parallel import topology as _topo
+        _h = _topo.hybrid_axes(self.mesh)
+        self._hier = _h is not None and hierarchical_enabled(hierarchical)
+        self._hier_shape = _h if self._hier else None
         super().__init__(shape=shape, dtype=dtype)
         if self.compute_dtype is None:  # env-policy default (f32 only)
             from ._precision import default_compute_dtype
@@ -219,13 +237,68 @@ class MPIVStack(MPILinearOperator):
             A, x.array)
         return full[:out_len]
 
+    def _rmatvec_batched_hier(self, x: DistributedArray) -> jax.Array:
+        """Two-level form of the batched adjoint reduction for hybrid
+        meshes (overlap on, round 11): each device computes its full
+        partial with one GEMM, then the hierarchical reduce-scatter +
+        all-gather pair replaces the partitioner's psum — the inner ICI
+        ring reduces within each slice first, so the outer DCN stage
+        moves ``P_ici``-times-fewer, larger messages."""
+        import jax.numpy as _jnp
+        from ..jaxcompat import shard_map
+        from jax.sharding import PartitionSpec as PSpec
+        from ..parallel.collectives import (hier_all_gather,
+                                            hier_psum_scatter)
+
+        A, adj = self._batched, self._batched_adj
+        dcn_ax, ici_ax, D, I = self._hier_shape
+        P_ = D * I
+        nblk = A.shape[0]
+        ncol = int(x.global_shape[1]) if x.ndim == 2 else None
+        if adj:
+            spec, out_len, conj, in_cols = (
+                "bmn,bn->m" if ncol is None else "bmn,bnk->mk",
+                A.shape[1], False, A.shape[2])
+        else:
+            spec, out_len, conj, in_cols = (
+                "bmn,bm->n" if ncol is None else "bmn,bmk->nk",
+                A.shape[2], True, A.shape[1])
+        Dp = P_ * (-(-out_len // P_))
+        cd, dt = self.compute_dtype, self.dtype
+        names = tuple(self.mesh.axis_names)
+
+        def kernel(Ab, xb):
+            xl = xb.reshape((nblk // P_, in_cols) if ncol is None
+                            else (nblk // P_, in_cols, ncol))
+            part = einsum_narrow(spec, _jnp.conj(Ab) if conj else Ab,
+                                 xl, cd, dt)
+            if Dp != out_len:
+                pad = [(0, 0)] * part.ndim
+                pad[0] = (0, Dp - out_len)
+                part = _jnp.pad(part, pad)
+            red = hier_psum_scatter(part, dcn_ax, ici_ax, D, I, dim=0)
+            return hier_all_gather(red, dcn_ax, ici_ax, D, I, dim=0)
+
+        full = shard_map(kernel, mesh=self.mesh,
+                         in_specs=(PSpec(names), PSpec(names)),
+                         out_specs=PSpec(None), check_vma=False)(
+            A, x.array)
+        return full[:out_len]
+
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
         ncol = int(x.global_shape[1]) if x.ndim == 2 else None
         if self._batched is not None:
             A, adj = self._batched, self._batched_adj
             nblk = A.shape[0]
-            if self._overlap and int(self.mesh.devices.size) > 1:
+            # the flat ring is written against a single mesh axis
+            # (axis_names[0] / devices.size); hybrid meshes take the
+            # two-level path when hierarchical is enabled and the bulk
+            # einsum-then-psum otherwise
+            if self._overlap and len(self.mesh.axis_names) == 1 \
+                    and int(self.mesh.devices.size) > 1:
                 acc = self._rmatvec_batched_ring(x)
+            elif self._overlap and self._hier:
+                acc = self._rmatvec_batched_hier(x)
             # per-block partials reduced over the sharded block axis —
             # the partitioner lowers the contraction to one psum, the
             # reference's sum-allreduce (ref VStack.py:135-150)
@@ -288,10 +361,11 @@ class MPIHStack(MPILinearOperator):
 
     def __init__(self, ops: Sequence[LocalOperator],
                  mask: Optional[Sequence[int]] = None,
-                 mesh=None, dtype=None, compute_dtype=None, overlap=None):
+                 mesh=None, dtype=None, compute_dtype=None, overlap=None,
+                 hierarchical=None):
         self.vstack = MPIVStack([op.H for op in ops], mask=mask, mesh=mesh,
                                 dtype=dtype, compute_dtype=compute_dtype,
-                                overlap=overlap)
+                                overlap=overlap, hierarchical=hierarchical)
         self.ops = self.vstack.ops
         shape = (self.vstack.shape[1], self.vstack.shape[0])
         super().__init__(shape=shape, dtype=self.vstack.dtype)

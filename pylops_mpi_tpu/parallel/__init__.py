@@ -2,3 +2,4 @@ from .mesh import (make_mesh, make_mesh_2d, make_mesh_hybrid,
                    initialize_multihost, default_mesh, set_default_mesh)
 from .partition import Partition, local_split
 from . import collectives
+from . import topology

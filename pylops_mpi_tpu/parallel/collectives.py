@@ -31,6 +31,16 @@ module holds only the hand-scheduled primitives the hot kernels consume:
   WITHOUT the concatenation, so stencil kernels can issue the
   ``ppermute``\\ s first and compute the interior while they fly.
 
+- the **topology-aware layer** (round 11,
+  ``PYLOPS_MPI_TPU_HIERARCHICAL``): :func:`hier_pencil_transpose`
+  (+ ``_planes``, chunked variants), :func:`hier_psum_scatter`,
+  :func:`hier_all_gather`, and :func:`ring_pass`'s ``slice_size``
+  schedule — two-level decompositions for hybrid (dcn × ici) meshes
+  that keep the dense exchange on ICI and stage one smaller transfer
+  over DCN, with per-fabric byte counters
+  (``collective.*.bytes_ici``/``.bytes_dcn``). Fabric classification
+  comes from :mod:`pylops_mpi_tpu.parallel.topology`.
+
 Generic allreduce/allgather wrappers existed in round 1 but had no
 production call sites (reductions lower to ``psum`` through GSPMD
 already) and were removed rather than kept as padding.
@@ -67,6 +77,12 @@ __all__ = [
     "resolve_chunks",
     "chunked_pencil_transpose",
     "chunked_pencil_transpose_planes",
+    "hier_pencil_transpose",
+    "hier_pencil_transpose_planes",
+    "hier_chunked_pencil_transpose",
+    "hier_chunked_pencil_transpose_planes",
+    "hier_psum_scatter",
+    "hier_all_gather",
 ]
 
 _logger = logging.getLogger("pylops_mpi_tpu.collectives")
@@ -92,14 +108,25 @@ def _collective_seq(name: str) -> int:
     return n
 
 
-def _count_collective(name: str, nbytes: Optional[int] = None) -> int:
+def _count_collective(name: str, nbytes: Optional[int] = None,
+                      fabric: Optional[str] = None,
+                      nbytes_ici: Optional[int] = None,
+                      nbytes_dcn: Optional[int] = None) -> int:
     """Metrics + sequencing for one collective dispatch: bumps the
     per-op call (and, when an estimate exists, byte) counters in the
     metrics registry and returns this call's sequence number for the
-    span tags."""
+    span tags. Round 11: ``fabric`` attributes single-fabric bytes to
+    ``.bytes_ici``/``.bytes_dcn`` (``None`` — a flat mesh — keeps only
+    the legacy ``.bytes`` counter); a two-level collective passes its
+    per-phase shares via ``nbytes_ici``/``nbytes_dcn`` instead, which
+    sum into the legacy counter."""
     _metrics.inc(f"collective.{name}.calls")
     if nbytes is not None:
-        _metrics.inc(f"collective.{name}.bytes", int(nbytes))
+        _metrics.collective_bytes(name, int(nbytes), fabric)
+    if nbytes_ici:
+        _metrics.collective_bytes(name, int(nbytes_ici), "ici")
+    if nbytes_dcn:
+        _metrics.collective_bytes(name, int(nbytes_dcn), "dcn")
     return _collective_seq(name)
 
 
@@ -190,7 +217,8 @@ def plane_all_to_all(br: jax.Array, bi: jax.Array, axis_name: str, *,
 
 def cart_halo_extend(block: jax.Array, axis_name: str,
                      grid: Sequence[int], ax: int, hm: int, hp: int,
-                     valid_len, array_axis: int = None) -> jax.Array:
+                     valid_len, array_axis: int = None,
+                     slice_map: Optional[Sequence[int]] = None) -> jax.Array:
     """One axis of a Cartesian-grid halo exchange, for use *inside* a
     ``shard_map`` kernel: extends ``block`` along array axis ``ax`` with
     ``hm`` ghost rows from the minus-neighbour and ``hp`` from the
@@ -220,20 +248,51 @@ def cart_halo_extend(block: jax.Array, axis_name: str,
     g_ax = int(grid[ax])
     if hm == 0 and hp == 0:
         return block
-    _trace.event("collective.cart_halo_extend", cat="collective",
-                 shape=getattr(block, "shape", None),
-                 dtype=getattr(block, "dtype", None), axis=axis_name,
-                 grid=tuple(int(g) for g in grid), ax=ax, hm=hm, hp=hp,
-                 seq=_count_collective("cart_halo_extend"))
-    if g_ax == 1:
-        padw = [(0, 0)] * block.ndim
-        padw[a_ax] = (hm, hp)
-        return jnp.pad(block, padw)
     # flat-rank stride between ax-neighbours in the row-major grid
     stride = int(np.prod([int(g) for g in grid[ax + 1:]]))
     n = int(np.prod([int(g) for g in grid]))
     coords = [np.unravel_index(r, tuple(int(g) for g in grid))[ax]
               for r in range(n)]
+    # per-fabric ghost bytes (round 11): only when the caller resolved
+    # a slice map for the flat rank order (hybrid meshes) — flat meshes
+    # keep the legacy calls-only counter byte-for-byte. Attribution is
+    # the per-device average over the grid's neighbour pairs, the same
+    # formula the cost model uses (model vs trace must agree).
+    nb_ici = nb_dcn = None
+    if slice_map is not None and g_ax > 1:
+        try:
+            row = block.size // block.shape[a_ax] * block.dtype.itemsize
+        except (AttributeError, TypeError, ZeroDivisionError):
+            row = None
+        if row is not None:
+            nb_ici = nb_dcn = 0
+            for h, pairs in (
+                    (hm, [(r, r + stride) for r in range(n)
+                          if coords[r] < g_ax - 1]),
+                    (hp, [(r, r - stride) for r in range(n)
+                          if coords[r] > 0])):
+                if not h:
+                    continue
+                cross = sum(1 for s, t in pairs
+                            if slice_map[s] != slice_map[t])
+                nb_ici += row * h * (len(pairs) - cross)
+                nb_dcn += row * h * cross
+            # per-device average, divided once at the end — a per-term
+            # floor would zero out the few DCN-crossing pairs entirely
+            nb_ici = -(-nb_ici // n)
+            nb_dcn = -(-nb_dcn // n)
+    _trace.event("collective.cart_halo_extend", cat="collective",
+                 shape=getattr(block, "shape", None),
+                 dtype=getattr(block, "dtype", None), axis=axis_name,
+                 grid=tuple(int(g) for g in grid), ax=ax, hm=hm, hp=hp,
+                 **({"fabric": "split"} if nb_ici is not None else {}),
+                 seq=_count_collective("cart_halo_extend",
+                                       nbytes_ici=nb_ici,
+                                       nbytes_dcn=nb_dcn))
+    if g_ax == 1:
+        padw = [(0, 0)] * block.ndim
+        padw[a_ax] = (hm, hp)
+        return jnp.pad(block, padw)
     parts = []
     if hm:
         # my valid tail -> plus-neighbour's front ghost
@@ -253,7 +312,7 @@ def cart_halo_extend(block: jax.Array, axis_name: str,
 
 def halo_slab(block, axis_name: str, n_shards: int, ax: int,
               front: int, back: int, valid, s_phys: int,
-              ragged: bool):
+              ragged: bool, slice_map: Optional[Sequence[int]] = None):
     """Ragged-aware ghosted slab for use *inside* a ``shard_map``
     kernel: :func:`cart_halo_extend` along ``ax`` plus, for ragged
     (pad-to-max) blocks, relocation of the received back ghost to sit
@@ -269,7 +328,8 @@ def halo_slab(block, axis_name: str, n_shards: int, ax: int,
     (``ops/derivatives.py``) and ``DistributedArray.ghosted``; ``ax``
     is the ARRAY axis, the mesh is always the 1-D ring."""
     slab = cart_halo_extend(block, axis_name, (n_shards,), 0, front,
-                            back, valid, array_axis=ax)
+                            back, valid, array_axis=ax,
+                            slice_map=slice_map)
     if ragged and back:
         bk = lax.slice_in_dim(slab, front + s_phys, front + s_phys + back,
                               axis=ax)
@@ -286,7 +346,8 @@ def halo_slab(block, axis_name: str, n_shards: int, ax: int,
 # bit-identical to the pre-round-8 programs.
 
 def ring_pass(block, axis_name: str, n_shards: int, body: Callable,
-              init=None, shift: int = 1):
+              init=None, shift: int = 1, slice_size: Optional[int] = None,
+              fabric: Optional[str] = None):
     """Double-buffered ring pipeline over one mesh axis: the resident
     buffer starts as this shard's ``block`` and rotates ``shift``
     positions per step, so after ``n_shards`` steps every shard has
@@ -302,14 +363,32 @@ def ring_pass(block, axis_name: str, n_shards: int, body: Callable,
     scheduler needs to hide the DMA behind the MXU. Exactly
     ``n_shards - 1`` collective-permutes are emitted, interleaved with
     ``n_shards`` ``body`` calls (the ``assert_ring_schedule`` pin,
-    ``utils/hlo.py``)."""
+    ``utils/hlo.py``).
+
+    ``slice_size`` (round 11) switches to the HIERARCHICAL hop
+    schedule for an axis whose rank order is slice-blocked (runs of
+    ``slice_size`` ICI-connected ranks, ``topology.slice_run``): the
+    inner ring rotates within the slice block and only every
+    ``slice_size``-th hop jumps a slice, so a full lap crosses DCN
+    ``n/slice_size - 1`` times instead of on (up to) every hop. Same
+    hop count, same double buffering, every block still visited
+    exactly once — but the visit ORDER differs from the flat ring, so
+    non-commutative accumulations see a different (equally valid)
+    reduction order. ``fabric``: single-fabric byte attribution for
+    the flat schedule on a classified mesh (``None`` = legacy
+    counter)."""
     n = int(n_shards)
+    L = int(slice_size) if slice_size else 0
+    if 1 < L < n and n % L == 0 and shift == 1 and n > 1:
+        return _ring_pass_hier(block, axis_name, n, body, init, L)
     with _trace.span("collective.ring_pass", cat="collective",
                      shape=getattr(block, "shape", None),
                      dtype=getattr(block, "dtype", None), axis=axis_name,
                      n_shards=n, shift=shift, hops=n - 1,
+                     **({"fabric": fabric} if fabric else {}),
                      seq=_count_collective(
-                         "ring_pass", _est_bytes(block, n - 1))):
+                         "ring_pass", _est_bytes(block, n - 1),
+                         fabric=fabric)):
         i = lax.axis_index(axis_name)
         perm = [(r, (r - shift) % n) for r in range(n)]
         acc = init
@@ -323,8 +402,54 @@ def ring_pass(block, axis_name: str, n_shards: int, body: Callable,
         return acc
 
 
+def _ring_pass_hier(block, axis_name, n: int, body: Callable, init,
+                    ici: int):
+    """Two-level ring schedule over one slice-blocked axis (see
+    :func:`ring_pass`): the axis's ``n`` ranks fall in ``n//ici``
+    slice blocks of ``ici`` ranks each. Inner hops rotate the resident
+    buffer within the block (pure ICI); after each full inner lap one
+    outer hop shifts every resident one block down (the lap's single
+    DCN crossing — ``n//ici - 1`` total vs the flat ring's worst case
+    of one per hop). Device ``r = (d, l)``'s resident before body call
+    ``t`` (with ``k = t // ici`` outer hops done) is the block of
+    owner ``((d+k) % D, (l + t-k) % ici)``; over ``t = 0..n-1`` that
+    enumerates every owner exactly once."""
+    dn = n // ici
+    blk_bytes = _est_bytes(block)
+    with _trace.span("collective.ring_pass", cat="collective",
+                     shape=getattr(block, "shape", None),
+                     dtype=getattr(block, "dtype", None), axis=axis_name,
+                     n_shards=n, shift=1, hops=n - 1, hierarchical=True,
+                     slice_size=ici,
+                     seq=_count_collective(
+                         "ring_pass",
+                         nbytes_ici=(blk_bytes * dn * (ici - 1)
+                                     if blk_bytes else None),
+                         nbytes_dcn=(blk_bytes * (dn - 1)
+                                     if blk_bytes else None))):
+        r = lax.axis_index(axis_name)
+        d, l = r // ici, r % ici
+        perm_inner = [(q, (q // ici) * ici + ((q % ici) - 1) % ici)
+                      for q in range(n)]
+        perm_outer = [(q, (q - ici) % n) for q in range(n)]
+        acc = init
+        resident = block
+        for t in range(n):
+            if t < n - 1:
+                perm = perm_outer if (t + 1) % ici == 0 else perm_inner
+                nxt = lax.ppermute(resident, axis_name, perm)
+            else:
+                nxt = None
+            k = t // ici
+            owner = ((d + k) % dn) * ici + (l + (t - k)) % ici
+            acc = body(acc, resident, owner, t)
+            resident = nxt
+        return acc
+
+
 def ring_halo_ghosts(block, axis_name: str, n_shards: int,
-                     front: int, back: int, valid_len, ax: int = 0):
+                     front: int, back: int, valid_len, ax: int = 0,
+                     slice_map: Optional[Sequence[int]] = None):
     """The 1-D ring halo exchange's two ghost slabs, WITHOUT stitching
     them onto the block: ``(front_ghost, back_ghost)`` — the
     predecessor's ``front`` valid tail rows and the successor's
@@ -339,11 +464,35 @@ def ring_halo_ghosts(block, axis_name: str, n_shards: int,
     (``ops/derivatives.py`` overlap path). ``None`` is returned for a
     zero-width side."""
     n = int(n_shards)
+    nb_ici = nb_dcn = None
+    if slice_map is not None and n > 1:
+        try:
+            row = block.size // block.shape[ax] * block.dtype.itemsize
+        except (AttributeError, TypeError, ZeroDivisionError):
+            row = None
+        if row is not None:
+            nb_ici = nb_dcn = 0
+            for h, pairs in (
+                    (front, [(r, r + 1) for r in range(n - 1)]),
+                    (back, [(r, r - 1) for r in range(1, n)])):
+                if not h:
+                    continue
+                cross = sum(1 for s, t in pairs
+                            if slice_map[s] != slice_map[t])
+                nb_ici += row * h * (len(pairs) - cross)
+                nb_dcn += row * h * cross
+            # per-device average, divided once at the end — a per-term
+            # floor would zero out the few DCN-crossing pairs entirely
+            nb_ici = -(-nb_ici // n)
+            nb_dcn = -(-nb_dcn // n)
     with _trace.span("collective.ring_halo_ghosts", cat="collective",
                      shape=getattr(block, "shape", None),
                      dtype=getattr(block, "dtype", None), axis=axis_name,
                      n_shards=n, front=front, back=back, ax=ax,
-                     seq=_count_collective("ring_halo_ghosts")):
+                     **({"fabric": "split"} if nb_ici is not None else {}),
+                     seq=_count_collective("ring_halo_ghosts",
+                                           nbytes_ici=nb_ici,
+                                           nbytes_dcn=nb_dcn)):
         gf = gb = None
         if front:
             start = jnp.maximum(valid_len - front, 0)
@@ -488,6 +637,256 @@ def chunked_pencil_transpose_planes(br, bi, axis_name: str,
             return (jnp.concatenate(outs_r, axis=out_ax),
                     jnp.concatenate(outs_i, axis=out_ax))
         return outs_r[0], outs_i[0]
+
+
+# --------------------------------------------------------------------------
+# Topology-aware layer (round 11, PYLOPS_MPI_TPU_HIERARCHICAL): two-level
+# schedules for hybrid (dcn x ici) meshes. Every flat collective above
+# treats its axis as one uniform fabric; on a multi-slice pod that routes
+# the dense shuffle over ~10 GB/s DCN links exactly like the ~100 GB/s
+# ICI ones. The primitives here decompose each exchange into an
+# intra-slice phase on the ICI axis plus one staged inter-slice phase on
+# the DCN axis (arXiv 2112.09017's hierarchy, with arXiv 2112.01075's
+# decomposition vocabulary), and stamp per-fabric byte counters
+# (collective.*.bytes_ici / .bytes_dcn) so the split is visible to the
+# round-9 aggregator and the round-11 cost model. All are for use INSIDE
+# a shard_map kernel over a mesh holding both named axes; the fabric
+# assignment comes from pylops_mpi_tpu.parallel.topology at the call
+# site. With PYLOPS_MPI_TPU_HIERARCHICAL=off nothing here is reached and
+# the flat programs stay bit-identical (the HLO pin in the tests).
+
+def _hier_reorder(b, ax: int, d: int, i: int, inverse: bool = False):
+    """Local column-block permutation pairing the two-level exchange
+    with the flat combined-axis block order: the flat
+    ``all_to_all(b, (dcn, ici), ...)`` deals axis-``ax`` blocks to
+    devices in dcn-major rank order ``r = d*I + i``, while the
+    ici-then-dcn two-phase exchange consumes them ici-major — so view
+    the axis as ``(d, i, w)`` and swap the two leading factors before
+    the phases (``inverse=True`` undoes it after the reverse
+    phases). Pure local data movement, no collective."""
+    w = b.shape[ax] // (d * i)
+    pre, post = b.shape[:ax], b.shape[ax + 1:]
+    f0, f1 = (i, d) if inverse else (d, i)
+    b = b.reshape(pre + (f0, f1, w) + post)
+    b = jnp.swapaxes(b, ax, ax + 1)
+    return b.reshape(pre + (d * i * w,) + post)
+
+
+def _hier_transpose_raw(b, dcn_axis: str, ici_axis: str, n_dcn: int,
+                        n_ici: int, out_ax: int, forward: bool):
+    """Span-free body of :func:`hier_pencil_transpose` (shared with the
+    chunked/planar wrappers, which carry their own spans)."""
+    d, i = int(n_dcn), int(n_ici)
+    if forward:
+        b = _hier_reorder(b, out_ax, d, i)
+        if i > 1:
+            b = lax.all_to_all(b, ici_axis, split_axis=out_ax,
+                               concat_axis=0, tiled=True)
+        if d > 1:
+            b = lax.all_to_all(b, dcn_axis, split_axis=out_ax,
+                               concat_axis=0, tiled=True)
+        return b
+    if d > 1:
+        b = lax.all_to_all(b, dcn_axis, split_axis=0,
+                           concat_axis=out_ax, tiled=True)
+    if i > 1:
+        b = lax.all_to_all(b, ici_axis, split_axis=0,
+                           concat_axis=out_ax, tiled=True)
+    return _hier_reorder(b, out_ax, d, i, inverse=True)
+
+
+def hier_pencil_transpose(b, dcn_axis: str, ici_axis: str, n_dcn: int,
+                          n_ici: int, out_ax: int, forward: bool = True):
+    """Two-level pencil transpose for use *inside* a shard_map kernel
+    over a hybrid mesh — bit-identical in result to the flat
+    ``lax.all_to_all(b, (dcn_axis, ici_axis), split_axis=out_ax,
+    concat_axis=0, tiled=True)`` (``forward``) / its inverse
+    (``forward=False``), but scheduled as a local reorder + an
+    intra-slice all-to-all on the ICI axis + ONE inter-slice all-to-all
+    on the DCN axis. Each device's DCN payload drops from the portable
+    flat decomposition's rotating volume to the direct
+    ``(D-1)/D`` share of its shard — the "keep the dense shuffle on
+    ICI" schedule of arXiv 2112.09017; the two phases are the
+    ici/dcn factorization of arXiv 2112.01075's reshard algebra."""
+    d, i = int(n_dcn), int(n_ici)
+    L = _est_bytes(b)
+    with _trace.span("collective.hier_pencil_transpose", cat="collective",
+                     shape=b.shape, dtype=b.dtype, dcn_axis=dcn_axis,
+                     ici_axis=ici_axis, n_dcn=d, n_ici=i, out_ax=out_ax,
+                     forward=forward, fabric="split",
+                     seq=_count_collective(
+                         "hier_pencil_transpose",
+                         nbytes_ici=(L * (i - 1) // i) if L else None,
+                         nbytes_dcn=(L * (d - 1) // d) if L else None)):
+        return _hier_transpose_raw(b, dcn_axis, ici_axis, d, i, out_ax,
+                                   forward)
+
+
+def hier_pencil_transpose_planes(br, bi, dcn_axis: str, ici_axis: str,
+                                 n_dcn: int, n_ici: int, out_ax: int,
+                                 forward: bool = True):
+    """Planar (re, im plane-pair) :func:`hier_pencil_transpose`: the
+    pair is stacked on a new trailing axis (same rationale as
+    :func:`plane_all_to_all` — the pair members must ride together
+    through the split) so each phase is ONE stacked real collective."""
+    d, i = int(n_dcn), int(n_ici)
+    L = _est_bytes(br, 2.0)
+    with _trace.span("collective.hier_pencil_transpose_planes",
+                     cat="collective", shape=br.shape, dtype=br.dtype,
+                     dcn_axis=dcn_axis, ici_axis=ici_axis, n_dcn=d,
+                     n_ici=i, out_ax=out_ax, forward=forward,
+                     planar=True, fabric="split",
+                     seq=_count_collective(
+                         "hier_pencil_transpose_planes",
+                         nbytes_ici=(L * (i - 1) // i) if L else None,
+                         nbytes_dcn=(L * (d - 1) // d) if L else None)):
+        s = jnp.stack([br, bi], axis=-1)
+        s = _hier_transpose_raw(s, dcn_axis, ici_axis, d, i, out_ax,
+                                forward)
+        return s[..., 0], s[..., 1]
+
+
+def hier_chunked_pencil_transpose(b, dcn_axis: str, ici_axis: str,
+                                  n_dcn: int, n_ici: int, out_ax: int,
+                                  chunks: int, mid: Callable):
+    """Streamed double pencil transpose over a hybrid mesh — the
+    two-level counterpart of :func:`chunked_pencil_transpose`: each of
+    the ``chunks`` tiles runs reorder → ICI all-to-all → staged DCN
+    all-to-all → ``mid`` → the reverse phases. The DCN exchange is
+    thereby CHUNKED as well as staged: tile ``k``'s slow inter-slice
+    transfer overlaps tile ``k±1``'s local transforms and ICI
+    shuffles. Same padding/crop contract as the flat chunked
+    transpose."""
+    d, i = int(n_dcn), int(n_ici)
+    n_shards = d * i
+    K = int(chunks)
+    tile = K * n_shards
+    bo = -(-b.shape[out_ax] // tile)
+    L = _est_bytes(b, 2.0)
+    with _trace.span("collective.hier_chunked_pencil_transpose",
+                     cat="collective", shape=b.shape, dtype=b.dtype,
+                     dcn_axis=dcn_axis, ici_axis=ici_axis, n_dcn=d,
+                     n_ici=i, out_ax=out_ax, chunks=K, fabric="split",
+                     seq=_count_collective(
+                         "hier_chunked_pencil_transpose",
+                         nbytes_ici=(L * (i - 1) // i) if L else None,
+                         nbytes_dcn=(L * (d - 1) // d) if L else None)):
+        b = _pad_axis_to(b, out_ax, tile * bo)
+        cw = n_shards * bo
+        outs = []
+        for k in range(K):
+            ck = lax.slice_in_dim(b, k * cw, (k + 1) * cw, axis=out_ax)
+            ck = _hier_transpose_raw(ck, dcn_axis, ici_axis, d, i,
+                                     out_ax, True)
+            ck = mid(ck)
+            ck = _hier_transpose_raw(ck, dcn_axis, ici_axis, d, i,
+                                     out_ax, False)
+            outs.append(ck)
+        return jnp.concatenate(outs, axis=out_ax) if K > 1 else outs[0]
+
+
+def hier_chunked_pencil_transpose_planes(br, bi, dcn_axis: str,
+                                         ici_axis: str, n_dcn: int,
+                                         n_ici: int, out_ax: int,
+                                         chunks: int, mid: Callable):
+    """Planar :func:`hier_chunked_pencil_transpose`: per tile, ONE
+    stacked real collective per phase, ``mid(br_tile, bi_tile)``
+    returns the transformed pair."""
+    d, i = int(n_dcn), int(n_ici)
+    n_shards = d * i
+    K = int(chunks)
+    tile = K * n_shards
+    bo = -(-br.shape[out_ax] // tile)
+    L = _est_bytes(br, 4.0)
+    with _trace.span("collective.hier_chunked_pencil_transpose_planes",
+                     cat="collective", shape=br.shape, dtype=br.dtype,
+                     dcn_axis=dcn_axis, ici_axis=ici_axis, n_dcn=d,
+                     n_ici=i, out_ax=out_ax, chunks=K, planar=True,
+                     fabric="split",
+                     seq=_count_collective(
+                         "hier_chunked_pencil_transpose_planes",
+                         nbytes_ici=(L * (i - 1) // i) if L else None,
+                         nbytes_dcn=(L * (d - 1) // d) if L else None)):
+        br = _pad_axis_to(br, out_ax, tile * bo)
+        bi = _pad_axis_to(bi, out_ax, tile * bo)
+        cw = n_shards * bo
+        outs_r, outs_i = [], []
+        for k in range(K):
+            cr = lax.slice_in_dim(br, k * cw, (k + 1) * cw, axis=out_ax)
+            ci = lax.slice_in_dim(bi, k * cw, (k + 1) * cw, axis=out_ax)
+            s = jnp.stack([cr, ci], axis=-1)
+            s = _hier_transpose_raw(s, dcn_axis, ici_axis, d, i,
+                                    out_ax, True)
+            cr, ci = mid(s[..., 0], s[..., 1])
+            s = jnp.stack([cr, ci], axis=-1)
+            s = _hier_transpose_raw(s, dcn_axis, ici_axis, d, i,
+                                    out_ax, False)
+            outs_r.append(s[..., 0])
+            outs_i.append(s[..., 1])
+        if K > 1:
+            return (jnp.concatenate(outs_r, axis=out_ax),
+                    jnp.concatenate(outs_i, axis=out_ax))
+        return outs_r[0], outs_i[0]
+
+
+def hier_psum_scatter(x, dcn_axis: str, ici_axis: str, n_dcn: int,
+                      n_ici: int, dim: int = 0):
+    """Two-level reduce-scatter for use *inside* a shard_map kernel
+    over a hybrid mesh — value-equivalent (up to floating-point
+    reduction order) to ``lax.psum_scatter(x, (dcn_axis, ici_axis),
+    scatter_dimension=dim, tiled=True)``: a local reorder to ici-major
+    block order, the inner reduce-scatter over the ICI ring (full
+    payload, fast fabric), then the outer reduce-scatter over the DCN
+    axis on the ALREADY 1/P_ici-sized partials — the slow fabric moves
+    ``P_ici`` times fewer bytes than a flat decomposition would push
+    through it. Requires ``x.shape[dim]`` divisible by
+    ``n_dcn * n_ici``."""
+    d, i = int(n_dcn), int(n_ici)
+    L = _est_bytes(x)
+    with _trace.span("collective.hier_psum_scatter", cat="collective",
+                     shape=x.shape, dtype=x.dtype, dcn_axis=dcn_axis,
+                     ici_axis=ici_axis, n_dcn=d, n_ici=i, dim=dim,
+                     fabric="split",
+                     seq=_count_collective(
+                         "hier_psum_scatter",
+                         nbytes_ici=(L * (i - 1) // i) if L else None,
+                         nbytes_dcn=(L * (d - 1) // (d * i))
+                         if L else None)):
+        x = _hier_reorder(x, dim, d, i)
+        if i > 1:
+            x = lax.psum_scatter(x, ici_axis, scatter_dimension=dim,
+                                 tiled=True)
+        if d > 1:
+            x = lax.psum_scatter(x, dcn_axis, scatter_dimension=dim,
+                                 tiled=True)
+        return x
+
+
+def hier_all_gather(x, dcn_axis: str, ici_axis: str, n_dcn: int,
+                    n_ici: int, dim: int = 0):
+    """Two-level all-gather for use *inside* a shard_map kernel over a
+    hybrid mesh — bit-identical in result to ``lax.all_gather(x,
+    (dcn_axis, ici_axis), axis=dim, tiled=True)``: gather the slice's
+    shards over the ICI axis first, then exchange the assembled
+    per-slice superblocks over the DCN axis — ``P_ici`` times FEWER,
+    larger DCN messages (one per slice pair instead of one per device
+    pair), the latency shape DCN wants (arXiv 2112.09017's
+    slice-leader staging)."""
+    d, i = int(n_dcn), int(n_ici)
+    L = _est_bytes(x)
+    with _trace.span("collective.hier_all_gather", cat="collective",
+                     shape=x.shape, dtype=x.dtype, dcn_axis=dcn_axis,
+                     ici_axis=ici_axis, n_dcn=d, n_ici=i, dim=dim,
+                     fabric="split",
+                     seq=_count_collective(
+                         "hier_all_gather",
+                         nbytes_ici=(L * (i - 1)) if L else None,
+                         nbytes_dcn=(L * i * (d - 1)) if L else None)):
+        if i > 1:
+            x = lax.all_gather(x, ici_axis, axis=dim, tiled=True)
+        if d > 1:
+            x = lax.all_gather(x, dcn_axis, axis=dim, tiled=True)
+        return x
 
 
 def ring_halo_extend(block, axis_name: str, n_shards: int,

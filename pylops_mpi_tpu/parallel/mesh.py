@@ -152,6 +152,14 @@ def make_mesh_hybrid(ici_axis: str = SP_AXIS, dcn_axis: str = "dcn",
     if dcn_size is None:
         dcn_size = nproc
     devs = jax.devices()
+    dcn_size = int(dcn_size)
+    if dcn_size > 1 and len(devs) % dcn_size:
+        divisors = [d for d in range(1, len(devs) + 1)
+                    if len(devs) % d == 0]
+        raise ValueError(
+            f"make_mesh_hybrid: dcn_size={dcn_size} does not divide the "
+            f"device count {len(devs)}; every slice must hold the same "
+            f"number of devices. Valid dcn_size values here: {divisors}")
     if dcn_size <= 1:
         return Mesh(np.asarray(devs).reshape(1, -1), (dcn_axis, ici_axis))
     try:

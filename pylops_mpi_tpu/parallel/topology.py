@@ -1,0 +1,271 @@
+"""Fabric topology: which mesh axes ride ICI and which ride DCN.
+
+Round 11. "Large Scale Distributed Linear Algebra With TPUs" (arXiv
+2112.09017) only reaches pod scale because its collectives respect the
+interconnect hierarchy: ~100 GB/s ICI links within a slice, ~10 GB/s
+DCN between slices. Every hand-scheduled collective in this library
+(ring SUMMA, pencil transposes, halo ghosts, stack reduce-scatter) runs
+over named mesh axes, so the topology question reduces to: *which
+fabric does each mesh axis span?* This module answers it from three
+sources, most-specific first:
+
+1. **Axis names** — ``make_mesh_hybrid`` names its outer axis ``dcn``;
+   any axis whose name starts with ``dcn`` is DCN by construction.
+2. **Device structure** — on real multi-slice hardware, an axis whose
+   device fibers span more than one slice (``device.slice_index``, or
+   ``process_index`` as the host-boundary proxy) crosses DCN.
+3. **``PYLOPS_MPI_TPU_FABRIC`` override** — a ``"DxI"`` string (e.g.
+   ``2x4``) declaring the device list to be D slices of I devices each
+   (id-major), so the 8-virtual-device CPU simulation can exercise the
+   hierarchical schedules and their per-fabric accounting without a
+   multi-slice pod.
+
+The classification feeds three consumers: the hierarchical schedules in
+:mod:`pylops_mpi_tpu.parallel.collectives` (which axes get the inner
+ring), the per-fabric byte split in ``diagnostics/costmodel.py`` /
+``diagnostics/metrics.py``, and :func:`topology_key` — the plan-cache
+key component that keeps tuner plans measured on one fabric layout from
+being replayed on another (flat meshes contribute an EMPTY key so every
+pre-round-11 cache entry keeps its key verbatim).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = [
+    "fabric_override",
+    "axis_fabric",
+    "mesh_fabrics",
+    "is_hybrid",
+    "hybrid_axes",
+    "topology_key",
+    "collective_fabric",
+    "slice_map",
+    "slice_run",
+    "perm_crossings",
+    "FABRIC_GBPS",
+]
+
+# Order-of-magnitude per-fabric bandwidths (GB/s per device, one
+# direction) for the cost-model split when no device-kind-specific
+# entry applies: ICI from the TPU v4 6-link torus numbers the roofline
+# already uses, DCN from the ~25 GB/s per-host NIC shared across the
+# slice's local devices. ``diagnostics/costmodel.py`` carries the
+# device-kind-resolved tables (PEAK_ICI_GBPS / PEAK_DCN_GBPS); this is
+# the fabric-relative anchor — what matters for schedule choice is the
+# ~10x ratio, not the absolute numbers.
+FABRIC_GBPS: Dict[str, float] = {"ici": 90.0, "dcn": 10.0}
+
+
+def fabric_override() -> Optional[Tuple[int, int]]:
+    """Parsed ``PYLOPS_MPI_TPU_FABRIC`` as ``(n_slices, per_slice)``,
+    or ``None`` when unset/empty. Malformed values raise (a typo'd CI
+    matrix must not silently fall back to flat classification)."""
+    raw = os.environ.get("PYLOPS_MPI_TPU_FABRIC", "").strip().lower()
+    if not raw:
+        return None
+    parts = raw.split("x")
+    if len(parts) != 2:
+        raise ValueError(
+            f"PYLOPS_MPI_TPU_FABRIC={raw!r}: expected 'DxI' (slices x "
+            "devices-per-slice), e.g. '2x4'")
+    try:
+        d, i = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"PYLOPS_MPI_TPU_FABRIC={raw!r}: expected 'DxI' with "
+            "integer D and I, e.g. '2x4'") from None
+    if d < 1 or i < 1:
+        raise ValueError(
+            f"PYLOPS_MPI_TPU_FABRIC={raw!r}: D and I must be >= 1")
+    return d, i
+
+
+def _slice_of(dev) -> int:
+    """Slice id of one device: the override's id-major blocks when
+    ``PYLOPS_MPI_TPU_FABRIC`` is set, else the hardware
+    ``slice_index``, else the owning process (host boundaries are the
+    DCN boundaries on every deployment this library targets)."""
+    ov = fabric_override()
+    if ov is not None and ov[0] > 1:
+        return int(getattr(dev, "id", 0)) // max(ov[1], 1)
+    s = getattr(dev, "slice_index", None)
+    if s is not None:
+        return int(s)
+    return int(getattr(dev, "process_index", 0))
+
+
+def axis_fabric(mesh: Mesh, axis: Union[str, int]) -> str:
+    """``"ici"`` or ``"dcn"`` for one mesh axis (by name or index).
+
+    An axis is DCN when its name says so (``dcn*``, the
+    ``make_mesh_hybrid`` convention) or when moving along it crosses a
+    slice boundary for any fiber of the device array; otherwise ICI.
+    Size-1 axes are ICI (they move nothing)."""
+    names = list(mesh.axis_names)
+    if isinstance(axis, str):
+        ax = names.index(axis)
+        name = axis
+    else:
+        ax = int(axis)
+        name = names[ax]
+    if str(name).lower().startswith("dcn"):
+        return "dcn"
+    devs = np.asarray(mesh.devices)
+    if devs.shape[ax] <= 1:
+        return "ici"
+    fibers = np.moveaxis(devs, ax, -1).reshape(-1, devs.shape[ax])
+    for fiber in fibers:
+        if len({_slice_of(d) for d in fiber}) > 1:
+            return "dcn"
+    return "ici"
+
+
+def mesh_fabrics(mesh: Mesh) -> Dict[str, str]:
+    """Axis-name -> fabric map for every axis of ``mesh``."""
+    return {str(n): axis_fabric(mesh, i)
+            for i, n in enumerate(mesh.axis_names)}
+
+
+def is_hybrid(mesh: Mesh) -> bool:
+    """True when the mesh has BOTH a >1-sized DCN axis and a >1-sized
+    ICI axis — the shape the hierarchical schedules decompose over. A
+    flat mesh (all axes one fabric, or any single-axis mesh) is not
+    hybrid even if that one axis crosses hosts: with no intra-slice
+    axis to stage through there is nothing hierarchical to do."""
+    devs = np.asarray(mesh.devices)
+    fabs = [(axis_fabric(mesh, i), int(devs.shape[i]))
+            for i in range(devs.ndim)]
+    return (any(f == "dcn" and s > 1 for f, s in fabs)
+            and any(f == "ici" and s > 1 for f, s in fabs))
+
+
+def hybrid_axes(mesh: Mesh) -> Optional[Tuple[str, str, int, int]]:
+    """``(dcn_axis, ici_axis, n_slices, per_slice)`` for a two-axis
+    hybrid mesh (the ``make_mesh_hybrid`` shape the hierarchical
+    kernels are written against), or ``None`` when the mesh is not
+    hybrid or has more than one axis per fabric."""
+    if not is_hybrid(mesh):
+        return None
+    devs = np.asarray(mesh.devices)
+    dcn = [(str(n), int(devs.shape[i]))
+           for i, n in enumerate(mesh.axis_names)
+           if axis_fabric(mesh, i) == "dcn" and devs.shape[i] > 1]
+    ici = [(str(n), int(devs.shape[i]))
+           for i, n in enumerate(mesh.axis_names)
+           if axis_fabric(mesh, i) == "ici" and devs.shape[i] > 1]
+    if len(dcn) != 1 or len(ici) != 1:
+        return None
+    return dcn[0][0], ici[0][0], dcn[0][1], ici[0][1]
+
+
+def topology_key(mesh: Mesh) -> str:
+    """Plan-cache key component for the fabric layout: EMPTY for every
+    non-hybrid mesh — so all pre-round-11 flat-mesh cache entries keep
+    their keys bit-for-bit — and ``dcn{D}xici{I}`` for a hybrid mesh,
+    so a plan measured on one slice decomposition never replays on
+    another."""
+    h = hybrid_axes(mesh)
+    if h is None:
+        return ""
+    _, _, d, i = h
+    return f"dcn{d}xici{i}"
+
+
+def collective_fabric(mesh: Mesh,
+                      axes: Union[str, Sequence[str], None]) -> Optional[str]:
+    """Fabric attribution for one collective dispatched over ``axes``
+    of ``mesh``: ``None`` on a non-hybrid mesh (callers keep the legacy
+    undifferentiated byte counters), ``"dcn"`` when any involved axis is
+    DCN (a mixed-axis collective is charged to the slow fabric — its
+    schedule is whatever XLA picks, and the conservative model from
+    arXiv 2112.01075's portable decompositions routes the rotating
+    payload over every link including DCN), else ``"ici"``."""
+    if not is_hybrid(mesh):
+        return None
+    if axes is None:
+        axes = tuple(mesh.axis_names)
+    if isinstance(axes, str):
+        axes = (axes,)
+    fabs = {axis_fabric(mesh, a) for a in axes}
+    return "dcn" if "dcn" in fabs else "ici"
+
+
+def slice_map(mesh: Mesh) -> Optional[Tuple[int, ...]]:
+    """Slice id of each linearized mesh rank (row-major over the mesh
+    axes — the order ``lax.axis_index`` linearizes and ``PartitionSpec``
+    shards), or ``None`` when every device sits in one slice. This is
+    the per-rank map the ghost-exchange primitives
+    (:func:`~pylops_mpi_tpu.parallel.collectives.cart_halo_extend` and
+    friends) take as ``slice_map`` for their per-fabric byte split —
+    ``None`` keeps the legacy undifferentiated counters."""
+    devs = np.asarray(mesh.devices).ravel()
+    ids = tuple(_slice_of(d) for d in devs)
+    return ids if len(set(ids)) > 1 else None
+
+
+def slice_run(mesh: Mesh, axis: Union[str, int]) -> Optional[int]:
+    """Length of the equal contiguous slice-blocks along one mesh axis,
+    or ``None`` when the axis is not slice-blocked. E.g. a grid column
+    axis over devices ``[0 1 2 3 | 4 5 6 7]`` of a 2x4 fabric runs in
+    blocks of 4 — the shape the hierarchical ring schedule
+    (:func:`~pylops_mpi_tpu.parallel.collectives.ring_pass` with
+    ``slice_size``) needs: consecutive ranks within a block are ICI
+    neighbours, block-to-block hops are the only DCN crossings.
+    Returns ``None`` for single-slice axes (nothing to stage) and for
+    interleaved layouts (a hierarchical schedule would not reduce
+    crossings there)."""
+    names = list(mesh.axis_names)
+    ax = names.index(axis) if isinstance(axis, str) else int(axis)
+    devs = np.asarray(mesh.devices)
+    n = int(devs.shape[ax])
+    if n <= 1:
+        return None
+    fiber = np.moveaxis(devs, ax, 0).reshape(n, -1)[:, 0]
+    sl = [_slice_of(d) for d in fiber]
+    # contiguous run lengths
+    runs, cur = [], 1
+    for a, b in zip(sl, sl[1:]):
+        if a == b:
+            cur += 1
+        else:
+            runs.append(cur)
+            cur = 1
+    runs.append(cur)
+    L = runs[0]
+    if L <= 1 or len(runs) <= 1 or any(r != L for r in runs):
+        return None
+    # distinct slices per run boundary (an A A B B A A layout is
+    # blocked but revisits a slice; still fine for the ring — every
+    # block hop crosses)
+    return L
+
+
+def perm_crossings(mesh: Mesh, axes: Union[str, Sequence[str]],
+                   perm: Sequence[Tuple[int, int]]) -> Tuple[int, int]:
+    """``(n_ici, n_dcn)``: how many ``(src, dst)`` pairs of a
+    ``ppermute`` over ``axes`` stay within a slice vs cross one — the
+    per-fabric split of a ghost/ring exchange whose byte volume is
+    uniform per pair (halo slabs, ring hops). Ranks are row-major over
+    ``axes`` in the given order, matching ``lax.axis_index`` on the
+    tuple; the representative device of each rank is taken at index 0
+    of the remaining axes (slice membership cannot vary across them
+    for any mesh this library constructs)."""
+    if isinstance(axes, str):
+        axes = (axes,)
+    names = list(mesh.axis_names)
+    devs = np.asarray(mesh.devices)
+    order = [names.index(a) for a in axes]
+    order += [i for i in range(devs.ndim) if i not in order]
+    devs = np.transpose(devs, order)
+    k = len(axes)
+    lead = int(np.prod(devs.shape[:k], dtype=np.int64)) if k else 1
+    reps = devs.reshape(lead, -1)[:, 0]
+    sl = [_slice_of(d) for d in reps]
+    cross = sum(1 for s, d in perm if sl[int(s)] != sl[int(d)])
+    return len(perm) - cross, cross

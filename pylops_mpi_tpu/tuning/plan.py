@@ -31,7 +31,12 @@ can never be overridden by a cache entry.
 Keys are ``(op family, logical shape bucket, dtype, mesh axes+size,
 chip kind)`` — :func:`plan_key`. Shapes bucket to the next power of
 two per dim so a 4000² problem replays the 4096² plan; topology and
-chip are exact (a v5e plan must not replay on a v6e).
+chip are exact (a v5e plan must not replay on a v6e). Hybrid meshes
+(round 11) additionally key on the fabric layout
+(:func:`~pylops_mpi_tpu.parallel.topology.topology_key`): a plan
+measured on a ``2x4`` slice decomposition must not replay on ``4x2``
+— while flat meshes contribute an EMPTY component, so every
+pre-round-11 cache entry keeps its key byte-for-byte.
 """
 
 from __future__ import annotations
@@ -152,6 +157,10 @@ def plan_key(op: str, shape, dtype=None, n_dev: Optional[int] = None,
     # ring step); K=1 keeps the historical key so existing caches hit
     if extra and extra.get("batch") and int(extra["batch"]) != 1:
         key += f"|b{int(extra['batch'])}"
+    # fabric layout (round 11): only hybrid meshes carry one — a flat
+    # mesh appends NOTHING, so pre-round-11 cache keys stay verbatim
+    if extra and extra.get("topology"):
+        key += f"|t{extra['topology']}"
     return key
 
 
@@ -207,6 +216,12 @@ def get_plan(op: str, *, shape, dtype=None, mesh=None,
     if mesh is not None:
         n_dev = n_dev if n_dev is not None else int(mesh.devices.size)
         axes = axes if axes is not None else tuple(mesh.axis_names)
+        if not (extra or {}).get("topology"):
+            from ..parallel import topology as _topo
+            tk = _topo.topology_key(mesh)
+            if tk:
+                extra = dict(extra or {})
+                extra["topology"] = tk
     key = plan_key(op, shape, dtype, n_dev, axes, extra)
     ctx = _context(op, shape, dtype, n_dev, axes, extra)
 

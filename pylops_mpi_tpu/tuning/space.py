@@ -107,8 +107,34 @@ def _peaks(context: Dict) -> Dict:
         chip = context.get("chip") or ""
         return {"flops": costmodel.peak_flops(chip, "f32_highest"),
                 "hbm_gbps": costmodel.peak_hbm_gbps(chip),
-                "ici_gbps": costmodel.peak_ici_gbps(chip)}
-    return {"flops": None, "hbm_gbps": 30.0 / nd, "ici_gbps": 30.0 / nd}
+                "ici_gbps": costmodel.peak_ici_gbps(chip),
+                "dcn_gbps": costmodel.peak_dcn_gbps(chip)}
+    # CPU sim: the DCN "bandwidth" only needs the ~9x ICI:DCN ratio
+    # (parallel/topology.FABRIC_GBPS) so the hierarchical seed orders
+    # schedules the way a real hybrid fabric would
+    return {"flops": None, "hbm_gbps": 30.0 / nd, "ici_gbps": 30.0 / nd,
+            "dcn_gbps": 30.0 / nd / 9.0}
+
+
+def _fabric_of(context: Dict) -> Optional[Tuple[int, int]]:
+    """``(n_slices, per_slice)`` parsed from the context's
+    ``extra["topology"]`` key component (``dcn{D}xici{I}``, injected by
+    ``plan.get_plan`` on hybrid meshes), or ``None`` on flat meshes —
+    where every seed below reduces to its pre-round-11 formula."""
+    t = str(context.get("extra", {}).get("topology") or "")
+    if t.startswith("dcn") and "xici" in t:
+        try:
+            d, i = t[3:].split("xici")
+            return int(d), int(i)
+        except ValueError:
+            return None
+    return None
+
+
+def _t_dcn(context: Dict, dcn_bytes: float) -> float:
+    pk = _peaks(context)
+    bw = pk.get("dcn_gbps")
+    return dcn_bytes / (bw * 1e9) if (bw and dcn_bytes) else 0.0
 
 
 def _dispatch_s(context: Dict) -> float:
@@ -162,16 +188,26 @@ def _cost_matrixmult(context: Dict, params: Dict) -> Optional[float]:
     pr, pc = max(1, int(grid[0])), max(1, int(grid[1]))
     P = pr * pc
     it = _itemsize(context)
-    from ..diagnostics.costmodel import summa_comm_volume
-    vols = summa_comm_volume(N, K, M, (pr, pc))
-    vol = vols.get(params.get("schedule", "gather"), vols["gather"])
+    from ..diagnostics.costmodel import summa_comm_volume_split
+    split = summa_comm_volume_split(N, K, M, (pr, pc))
+    sp = split.get(params.get("schedule", "gather"), split["gather"])
+    fab = _fabric_of(context)
+    if fab is None:
+        ici_b, dcn_b = (sp["r"] + sp["c"]) * it, 0.0
+    elif params.get("hierarchical") == "off":
+        # topology-blind on a hybrid mesh: conservative slow-fabric
+        # charge (mirrors costmodel._summa_fabric_split)
+        ici_b, dcn_b = 0.0, (sp["r"] + sp["c"]) * it
+    else:
+        ici_b, dcn_b = sp["c"] * it, sp["r"] * it
     pk = _peaks(context)
     flops = 2.0 * N * K * M / P
     hbm = (N * K + K * M + N * M) * it / P
     t_comp = flops / pk["flops"] if pk.get("flops") else 0.0
     t_hbm = hbm / (pk["hbm_gbps"] * 1e9) if pk.get("hbm_gbps") else 0.0
-    return _overlap_seed(context, params, vol * it, steps=pc - 1,
-                         base_s=max(t_comp, t_hbm))
+    return _overlap_seed(context, params, ici_b, steps=pc - 1,
+                         base_s=max(t_comp, t_hbm)) \
+        + _t_dcn(context, dcn_b)
 
 
 def _cost_fft(context: Dict, params: Dict) -> Optional[float]:
@@ -182,25 +218,29 @@ def _cost_fft(context: Dict, params: Dict) -> Optional[float]:
     it = _itemsize(context)
     n_total = float(np.prod([int(s) for s in shape]))
     from ..diagnostics.costmodel import pencil_transpose_cost
-    c = pencil_transpose_cost(tuple(int(s) for s in shape), P,
-                              itemsize=it)
+    c = pencil_transpose_cost(
+        tuple(int(s) for s in shape), P, itemsize=it,
+        fabric_shape=_fabric_of(context),
+        hierarchical=params.get("hierarchical") != "off")
     pk = _peaks(context)
     flops = 5.0 * n_total * math.log2(max(2.0, n_total)) / P
     t_comp = flops / pk["flops"] if pk.get("flops") else 0.0
     t_hbm = (c.hbm_bytes / (pk["hbm_gbps"] * 1e9)
              if pk.get("hbm_gbps") else 0.0)
+    t_dcn = _t_dcn(context, c.dcn_bytes)
     K = int(params.get("comm_chunks", 1))
     # each chunk adds one all-to-all dispatch pair per transpose; more
     # chunks hide more of the transfer behind the per-chunk transforms
     base = max(t_comp, t_hbm)
     if params.get("overlap") != "on" or K <= 1:
         pk_ici = pk.get("ici_gbps")
-        return base + (c.ici_bytes / (pk_ici * 1e9) if pk_ici else 0.0)
+        return base + t_dcn \
+            + (c.ici_bytes / (pk_ici * 1e9) if pk_ici else 0.0)
     hide = (0.5 * (1.0 - 1.0 / K)
             if context.get("platform") == "tpu" else 0.0)
     pk_ici = pk.get("ici_gbps")
     t_ici = c.ici_bytes / (pk_ici * 1e9) if pk_ici else 0.0
-    return base + (1.0 - hide) * t_ici \
+    return base + (1.0 - hide) * (t_ici + t_dcn) \
         + 2 * (K - 1) * _dispatch_s(context)
 
 
@@ -247,6 +287,25 @@ def _cost_halo_family(context: Dict, params: Dict) -> Optional[float]:
     return _overlap_seed(context, params, ici, steps=2)
 
 
+def _expand_hier(cands: List[Dict], context: Dict) -> List[Dict]:
+    """Expand candidates along the ``hierarchical`` axis — ONLY when
+    the context carries a hybrid-mesh topology key. Flat meshes have
+    nothing to stage, so their candidate lists (and cache entries, and
+    measurement budgets) stay exactly the pre-round-11 ones; on a
+    hybrid mesh ``auto`` resolves to on, so searching (on, off) covers
+    the whole behavior space without an aliased third trial."""
+    if not _fabric_of(context):
+        return cands
+    return [dict(p, hierarchical=h) for p in cands
+            for h in ("on", "off")]
+
+
+def _enum_matrixmult(context: Dict) -> List[Dict]:
+    base = [{"schedule": s, "overlap": o}
+            for s in ("gather", "stat_a") for o in ("off", "on")]
+    return _expand_hier(base, context)
+
+
 def _enum_fft(context: Dict) -> List[Dict]:
     """Overlap off makes the chunk count moot — one canonical bulk
     candidate plus the chunked ladder, instead of a product full of
@@ -258,7 +317,8 @@ def _enum_fft(context: Dict) -> List[Dict]:
         if k > 1 and k not in seen:
             seen.add(k)
             ladder.append({"overlap": "on", "comm_chunks": int(k)})
-    return [{"overlap": "off", "comm_chunks": 1}] + ladder
+    return _expand_hier([{"overlap": "off", "comm_chunks": 1}] + ladder,
+                        context)
 
 
 def _enum_blockdiag(context: Dict) -> List[Dict]:
@@ -354,23 +414,28 @@ register_space(TuningSpace(
     op="matrixmult",
     axes=(Axis("schedule", ("gather", "stat_a")),
           Axis("overlap", ("off", "on")),
+          Axis("hierarchical", ("auto", "on", "off")),
           Axis("comm_chunks", (1,), fixed=True),
           Axis("batch", (1, 2, 4, 8, 16, 32, 64), fixed=True)),
     cost=_cost_matrixmult,
     default_fn=_default_matrixmult,
-    note="SUMMA forward schedule x ring overlap; chunking is carried "
-         "by the ring step count, recorded for provenance only; batch "
-         "is the solve's block width (keyed, never searched)"))
+    enumerate_fn=_enum_matrixmult,
+    note="SUMMA forward schedule x ring overlap x (hybrid meshes only) "
+         "hierarchical staging; chunking is carried by the ring step "
+         "count, recorded for provenance only; batch is the solve's "
+         "block width (keyed, never searched)"))
 
 register_space(TuningSpace(
     op="fft",
     axes=(Axis("overlap", ("off", "on")),
           Axis("comm_chunks", (1, 2, 4, 8)),
+          Axis("hierarchical", ("auto", "on", "off")),
           Axis("engine", ("resolved",), fixed=True)),
     cost=_cost_fft,
     enumerate_fn=_enum_fft,
-    note="pencil-transpose chunking; the planar/complex engine is the "
-         "global PYLOPS_MPI_TPU_FFT_MODE seam (complex-free HLO pins) "
+    note="pencil-transpose chunking x (hybrid meshes only) two-level "
+         "staging; the planar/complex engine is the global "
+         "PYLOPS_MPI_TPU_FFT_MODE seam (complex-free HLO pins) "
          "— recorded in the plan, never flipped by the tuner"))
 
 register_space(TuningSpace(

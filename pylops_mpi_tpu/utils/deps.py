@@ -39,6 +39,19 @@ backend — XLA collectives — so the seam carries different switches:
   streamed pencil transposes when the overlap is enabled; per-operator
   ``comm_chunks=`` wins. Chunk counts that don't fit the axis fall
   back (logged) instead of erroring.
+- ``PYLOPS_MPI_TPU_HIERARCHICAL``: ``auto`` (default) | ``on`` |
+  ``off`` — the topology-aware collectives seam (round 11). ``on``
+  switches the comm-heavy operators to hierarchical schedules on
+  hybrid (dcn × ici) meshes: two-level pencil transposes that keep the
+  dense shuffle on ICI and stage one smaller exchange over DCN,
+  slice-staged rings and two-level reduce-scatter/all-gather. ``off``
+  keeps the flat schedules bit-identical; ``auto`` engages on real TPU
+  backends or when ``PYLOPS_MPI_TPU_FABRIC`` declares a simulated
+  fabric. Per-operator ``hierarchical=`` kwargs override the env.
+- ``PYLOPS_MPI_TPU_FABRIC``: ``DxI`` (e.g. ``2x4``) — CPU-sim fabric
+  override for :mod:`pylops_mpi_tpu.parallel.topology`: classify the
+  device list as D slices of I devices each when deciding which mesh
+  axes are ICI vs DCN.
 - ``PYLOPS_MPI_TPU_TRACE`` / ``PYLOPS_MPI_TPU_TELEMETRY`` /
   ``PYLOPS_MPI_TPU_TRACE_FILE`` / ``PYLOPS_MPI_TPU_PROFILE_DIR`` /
   ``PYLOPS_MPI_TPU_METRICS`` (``_FILE``, ``_INTERVAL``): the
@@ -58,6 +71,8 @@ __all__ = ["jax_enabled", "platform_override", "x64_enabled",
            "overlap_mode", "overlap_enabled", "comm_chunks_default",
            "batch_default",
            "overlap_env_pinned", "comm_chunks_env_pinned",
+           "hierarchical_mode", "hierarchical_enabled",
+           "hierarchical_env_pinned",
            "KNOBS", "knob_names", "knob_table_markdown"]
 
 jax_enabled = True  # the only engine; mirrors deps.nccl_enabled's role
@@ -90,6 +105,18 @@ KNOBS = [
     ("PYLOPS_MPI_TPU_COMM_CHUNKS", "int>=1", "4",
      "utils/deps.py, ops/fft.py",
      "default chunk count for streamed pencil transposes"),
+    ("PYLOPS_MPI_TPU_HIERARCHICAL", "auto|on|off", "auto",
+     "utils/deps.py (parallel/topology.py, "
+     "ops/matrixmult|fft|stack|halo|derivatives)",
+     "topology-aware hierarchical collectives on hybrid (dcn x ici) "
+     "meshes: two-level pencil transposes, slice-staged rings, "
+     "per-fabric byte accounting; off keeps the flat schedules "
+     "bit-identical"),
+    ("PYLOPS_MPI_TPU_FABRIC", "DxI (e.g. 2x4)", "unset (detect)",
+     "parallel/topology.py",
+     "fabric override for CPU-sim testing: treat the device list as D "
+     "slices of I devices each (id-major) when classifying mesh axes "
+     "as ICI/DCN"),
     ("PYLOPS_MPI_TPU_PRECISION", "f32|bf16|c64", "f32",
      "ops/_precision.py",
      "storage/compute precision policy for operators built with "
@@ -307,6 +334,66 @@ def overlap_env_pinned() -> bool:
     autotuner's plans, exactly like an explicit ``overlap=`` kwarg
     (``auto``/unset leaves the plan seam free to decide)."""
     return overlap_mode() in ("on", "off")
+
+
+_warned_hier = False
+
+
+def hierarchical_mode() -> str:
+    """``PYLOPS_MPI_TPU_HIERARCHICAL`` resolved to
+    ``auto``/``on``/``off`` (unknown values fall back to ``auto`` with
+    a one-time warning, same contract as :func:`overlap_mode`)."""
+    global _warned_hier
+    m = os.environ.get("PYLOPS_MPI_TPU_HIERARCHICAL",
+                       "auto").strip().lower()
+    if m in ("", "none", "default"):
+        m = "auto"
+    if m not in ("auto", "on", "off"):
+        if not _warned_hier:
+            import warnings
+            warnings.warn(
+                f"PYLOPS_MPI_TPU_HIERARCHICAL={m!r} is not one of "
+                "['auto', 'on', 'off']; using 'auto'", stacklevel=2)
+            _warned_hier = True
+        m = "auto"
+    return m
+
+
+def hierarchical_enabled(user=None) -> bool:
+    """Resolve the hierarchical-collectives tri-state to a bool.
+    ``user`` is a per-operator ``hierarchical=`` kwarg (``True``/
+    ``False``/``"on"``/``"off"``/``"auto"``; ``None`` defers to the
+    env). ``auto`` enables the hierarchical schedules on real TPU
+    backends and on CPU simulations that declare a fabric via
+    ``PYLOPS_MPI_TPU_FABRIC`` — everywhere else ``off`` keeps the flat
+    schedules bit-identical. A True result is still only *intent*: the
+    schedules engage per operator only when the mesh is actually
+    hybrid (``parallel.topology.is_hybrid``)."""
+    if isinstance(user, bool):
+        return user
+    if user is None:
+        mode = hierarchical_mode()
+    else:
+        mode = str(user).strip().lower()
+        if mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"hierarchical={user!r}: expected 'auto', 'on', 'off', "
+                "True or False")
+    if mode == "on":
+        return True
+    if mode == "off":
+        return False
+    if os.environ.get("PYLOPS_MPI_TPU_FABRIC", "").strip():
+        return True
+    import jax
+    return jax.default_backend() == "tpu"
+
+
+def hierarchical_env_pinned() -> bool:
+    """True when ``PYLOPS_MPI_TPU_HIERARCHICAL`` is explicitly ``on``
+    or ``off`` — explicit env settings beat the autotuner's plans,
+    same precedence rule as :func:`overlap_env_pinned`."""
+    return hierarchical_mode() in ("on", "off")
 
 
 def comm_chunks_env_pinned() -> bool:

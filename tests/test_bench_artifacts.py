@@ -389,6 +389,60 @@ def test_overlap_stage_merged_and_compacted(bench, tmp_path):
     assert "tpu_overlap" not in out2
 
 
+def test_hier_stage_merged_and_compacted(bench, tmp_path):
+    """The harvest ladder's hier stage (round 11 hierarchical-vs-flat
+    race) merges only as hardware evidence; the wall-clock side (the
+    number the CPU sim cannot measure) rides the compact line."""
+    root = str(tmp_path)
+    hier = {"kind": "hier_stage", "platform": "tpu", "fabric": "2x4",
+            "pencil": {"dims": [16, 8, 4], "dcn_reduction": 8.0,
+                       "time_hier_vs_flat": 0.6},
+            "summa": {"dcn_reduction": 7.0},
+            "worst_dcn_reduction": 7.0}
+    _write(root, cache={"hier": {"result": hier, "ts": "t",
+                                 "code_rev": "abc"}})
+    out = bench._merge_tpu_cache({"platform": "tpu", "value": 1.0},
+                                 root=root)
+    assert out["tpu_hier"]["worst_dcn_reduction"] == 7.0
+    line = bench._compact_line(out)
+    assert line["tpu_hier"] == {"worst_dcn_reduction": 7.0,
+                                "pencil_time_hier_vs_flat": 0.6}
+    # a CPU rehearsal of the same stage must NOT merge
+    _write(root, cache={"hier": {"result": dict(hier, platform="cpu"),
+                                 "ts": "t", "rehearse": True,
+                                 "code_rev": "abc"}})
+    out2 = bench._merge_tpu_cache({"platform": "tpu", "value": 1.0},
+                                  root=root)
+    assert "tpu_hier" not in out2
+
+
+def test_hier_row_compacted_and_survives_banked_headline(bench,
+                                                         tmp_path):
+    """The live CPU-sim DCN-byte race rides the compact line every
+    round (same rule as the tuner/batched races), even when a banked
+    TPU headline replaces the CPU-sim result."""
+    live = {"platform": "cpu", "value": 1.0,
+            "hierarchical_vs_flat": {
+                "fabric": "2x4",
+                "pencil": {"dcn_reduction": 8.0},
+                "summa": {"dcn_reduction": 7.0},
+                "worst_dcn_reduction": 7.0}}
+    line = bench._compact_line(live)
+    assert line["hier"] == {"pencil_dcn_reduction": 8.0,
+                            "summa_dcn_reduction": 7.0,
+                            "worst_dcn_reduction": 7.0}
+    bad = dict(live, hierarchical_vs_flat={"error": "x" * 500})
+    assert bench._compact_line(bad)["hier"] == {"error": "x" * 120}
+    root = str(tmp_path)
+    _write(root, cache={
+        "flagship_full": {"result": _tpu_result(99.0), "ts": "t"},
+    })
+    out = bench._merge_tpu_cache(dict(live), root=root)
+    assert out["cached"] and out["platform"] == "tpu"
+    assert out["hierarchical_vs_flat"]["worst_dcn_reduction"] == 7.0
+    assert bench._compact_line(out)["hier"]["worst_dcn_reduction"] == 7.0
+
+
 def test_fft_planar_stage_merged_and_compacted(bench, tmp_path):
     """The harvest ladder's fft_planar stage (the planar-FFT hardware
     verdict) merges under the same rules as bisect and surfaces an

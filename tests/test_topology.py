@@ -1,0 +1,165 @@
+"""Topology-layer tests (round 11, ``parallel/topology.py``).
+
+The fabric classifier is the root of every hierarchical decision —
+schedule selection, per-fabric byte attribution, and the tuner's
+``topology_key()`` cache keying — so its pins are behavioral, not
+structural: axis names, the ``PYLOPS_MPI_TPU_FABRIC`` CPU-sim override,
+slice maps/runs, and the guarantee that every FLAT mesh contributes an
+EMPTY key (pre-round-11 tuner cache entries must keep their keys
+byte-for-byte).
+"""
+
+import numpy as np
+import pytest
+import jax
+from jax.sharding import Mesh
+
+from pylops_mpi_tpu.parallel import topology as topo
+from pylops_mpi_tpu.parallel.mesh import make_mesh, make_mesh_hybrid
+from pylops_mpi_tpu.utils import deps
+
+P = len(jax.devices())
+
+pytestmark = pytest.mark.skipif(P != 8, reason="topology pins assume 8")
+
+
+@pytest.fixture
+def no_fabric(monkeypatch):
+    monkeypatch.delenv("PYLOPS_MPI_TPU_FABRIC", raising=False)
+
+
+@pytest.fixture
+def fabric24(monkeypatch):
+    monkeypatch.setenv("PYLOPS_MPI_TPU_FABRIC", "2x4")
+
+
+# -------------------------------------------------------- override parse
+def test_fabric_override_parse(monkeypatch):
+    monkeypatch.delenv("PYLOPS_MPI_TPU_FABRIC", raising=False)
+    assert topo.fabric_override() is None
+    monkeypatch.setenv("PYLOPS_MPI_TPU_FABRIC", "2x4")
+    assert topo.fabric_override() == (2, 4)
+    monkeypatch.setenv("PYLOPS_MPI_TPU_FABRIC", " 4X2 ")
+    assert topo.fabric_override() == (4, 2)
+
+
+@pytest.mark.parametrize("bad", ["2x", "x4", "axb", "2x4x2", "0x4", "-1x8"])
+def test_fabric_override_malformed_raises(monkeypatch, bad):
+    """A typo'd CI matrix must not silently fall back to flat."""
+    monkeypatch.setenv("PYLOPS_MPI_TPU_FABRIC", bad)
+    with pytest.raises(ValueError, match="PYLOPS_MPI_TPU_FABRIC"):
+        topo.fabric_override()
+
+
+# -------------------------------------------------------- classification
+def test_axis_fabric_by_name(no_fabric):
+    """make_mesh_hybrid's axis NAMES classify without any override:
+    the dcn* convention is authoritative even on the CPU sim where all
+    devices share one process."""
+    mesh = make_mesh_hybrid(dcn_size=2)
+    assert topo.axis_fabric(mesh, "dcn") == "dcn"
+    assert topo.axis_fabric(mesh, "sp") == "ici"
+    assert topo.mesh_fabrics(mesh) == {"dcn": "dcn", "sp": "ici"}
+    assert topo.is_hybrid(mesh)
+    assert topo.hybrid_axes(mesh) == ("dcn", "sp", 2, 4)
+    assert topo.topology_key(mesh) == "dcn2xici4"
+
+
+def test_flat_mesh_is_not_hybrid(no_fabric):
+    mesh = make_mesh()
+    assert topo.axis_fabric(mesh, 0) == "ici"
+    assert not topo.is_hybrid(mesh)
+    assert topo.hybrid_axes(mesh) is None
+    assert topo.topology_key(mesh) == ""  # flat cache keys unchanged
+    assert topo.collective_fabric(mesh, mesh.axis_names[0]) is None
+    assert topo.slice_map(mesh) is None
+
+
+def test_axis_fabric_by_override(fabric24):
+    """Under FABRIC=2x4 a slice-crossing axis classifies dcn even
+    without a dcn* name — but a single-axis mesh is still NOT hybrid
+    (no intra-slice axis to stage through)."""
+    mesh = make_mesh()
+    assert topo.axis_fabric(mesh, 0) == "dcn"
+    assert not topo.is_hybrid(mesh)
+    assert topo.topology_key(mesh) == ""
+    # anonymous (r, c) grid over the same devices: rows cross slices,
+    # columns stay inside one -> hybrid by structure alone
+    grid = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("r", "c"))
+    assert topo.axis_fabric(grid, "r") == "dcn"
+    assert topo.axis_fabric(grid, "c") == "ici"
+    assert topo.is_hybrid(grid)
+    assert topo.hybrid_axes(grid) == ("r", "c", 2, 4)
+    assert topo.collective_fabric(grid, "c") == "ici"
+    assert topo.collective_fabric(grid, "r") == "dcn"
+    assert topo.collective_fabric(grid, ("r", "c")) == "dcn"  # mixed
+
+
+def test_slice_map_and_run(fabric24):
+    mesh = make_mesh_hybrid(dcn_size=2)
+    assert topo.slice_map(mesh) == (0, 0, 0, 0, 1, 1, 1, 1)
+    # SUMMA's (1, 8) column axis: slice-blocked in runs of 4
+    col = Mesh(np.asarray(jax.devices()).reshape(1, 8), ("r", "c"))
+    assert topo.slice_run(col, "c") == 4
+    assert topo.slice_run(col, "r") is None  # size-1 axis
+    # interleaved layout: hierarchical ring would not reduce crossings
+    devs = jax.devices()
+    inter = Mesh(np.asarray([devs[i // 2 + 4 * (i % 2)]
+                             for i in range(8)]).reshape(1, 8),
+                 ("r", "c"))
+    assert topo.slice_run(inter, "c") is None
+
+
+def test_perm_crossings(fabric24):
+    mesh = make_mesh()
+    name = mesh.axis_names[0]
+    ring = [(r, (r + 1) % 8) for r in range(8)]
+    ici, dcn = topo.perm_crossings(mesh, name, ring)
+    assert (ici, dcn) == (6, 2)  # 3->4 and 7->0 cross
+    neigh = [(r, r + 1) for r in range(7)]
+    assert topo.perm_crossings(mesh, name, neigh) == (6, 1)
+
+
+# -------------------------------------------------------- mesh validation
+def test_make_mesh_hybrid_bad_dcn_size():
+    """Satellite: a non-dividing dcn_size names itself, the device
+    count, and the valid divisors instead of a reshape error."""
+    with pytest.raises(ValueError) as ei:
+        make_mesh_hybrid(dcn_size=3)
+    msg = str(ei.value)
+    assert "dcn_size=3" in msg
+    assert str(P) in msg
+    assert "[1, 2, 4, 8]" in msg
+
+
+# -------------------------------------------------------- knob resolution
+def test_hierarchical_mode_resolution(monkeypatch):
+    monkeypatch.delenv("PYLOPS_MPI_TPU_HIERARCHICAL", raising=False)
+    assert deps.hierarchical_mode() == "auto"
+    for raw, want in (("on", "on"), (" OFF ", "off"), ("auto", "auto"),
+                      ("", "auto")):
+        monkeypatch.setenv("PYLOPS_MPI_TPU_HIERARCHICAL", raw)
+        assert deps.hierarchical_mode() == want
+    monkeypatch.setenv("PYLOPS_MPI_TPU_HIERARCHICAL", "bogus")
+    deps._warned_hier = False
+    with pytest.warns(UserWarning, match="PYLOPS_MPI_TPU_HIERARCHICAL"):
+        assert deps.hierarchical_mode() == "auto"
+
+
+def test_hierarchical_enabled_auto(monkeypatch):
+    """auto = off on a plain CPU sim, on once a fabric is declared;
+    explicit kwarg and env pins override."""
+    monkeypatch.delenv("PYLOPS_MPI_TPU_HIERARCHICAL", raising=False)
+    monkeypatch.delenv("PYLOPS_MPI_TPU_FABRIC", raising=False)
+    assert deps.hierarchical_enabled(None) is False
+    assert not deps.hierarchical_env_pinned()
+    monkeypatch.setenv("PYLOPS_MPI_TPU_FABRIC", "2x4")
+    assert deps.hierarchical_enabled(None) is True
+    assert deps.hierarchical_enabled("off") is False
+    assert deps.hierarchical_enabled(False) is False
+    monkeypatch.setenv("PYLOPS_MPI_TPU_HIERARCHICAL", "off")
+    assert deps.hierarchical_enabled(None) is False
+    assert deps.hierarchical_env_pinned()
+    assert deps.hierarchical_enabled(True) is True  # kwarg beats env
+    with pytest.raises(ValueError, match="hierarchical="):
+        deps.hierarchical_enabled("sometimes")
