@@ -225,6 +225,78 @@ def _batched_race_row(niter=20):
         return {"error": repr(e)[:300]}
 
 
+def _serving_race_row(niter=20, n_requests=32):
+    """Serving race (the serving-PR acceptance bar): 32 single-RHS
+    requests through the continuous-batching daemon — packed into
+    K=16 block solves against prewarmed executables — vs the same 32
+    solved sequentially through the fused single-RHS path, on the
+    flagship block-diagonal family. ``tol=0`` pins every solve to
+    exactly ``niter`` iterations AND makes the padded block answers
+    bit-identical to the sequential oracles (the race asserts it).
+    Stamps ``solves_per_sec`` (wall basis, submit-to-last-result),
+    ``speedup_vs_sequential``, and the daemon's p50/p99
+    time-in-queue."""
+    try:
+        import numpy as _np
+        from pylops_mpi_tpu import DistributedArray, MPIBlockDiag
+        from pylops_mpi_tpu.ops.local import MatrixMult
+        from pylops_mpi_tpu.solvers import cgls
+        from pylops_mpi_tpu.serving import (FamilySpec, SolveDaemon,
+                                            WarmPool)
+        nblk, nblock = 8, 48
+        blocks, _, _ = make_problem(nblk, nblock, seed=3)
+        Op = MPIBlockDiag([MatrixMult(b, dtype=np.float32)
+                           for b in blocks])
+        N = nblk * nblock
+        rng = _np.random.default_rng(7)
+        Y = rng.standard_normal((N, n_requests)).astype(_np.float32)
+        ys = []
+        for j in range(n_requests):
+            yj = DistributedArray(global_shape=N, dtype=_np.float32)
+            yj[:] = Y[:, j]
+            ys.append(yj)
+
+        def run_seq():
+            return [_np.asarray(
+                cgls(Op, yj, niter=niter, tol=0.0)[0].array)
+                for yj in ys]
+
+        run_seq()     # compile the single-RHS program outside timing
+        t0 = time.perf_counter()
+        oracles = run_seq()
+        t_seq = time.perf_counter() - t0
+
+        pool = WarmPool(buckets=(16,))
+        pool.register(FamilySpec(name="flagship", operator=Op,
+                                 solver="cgls", niter=niter, tol=0.0))
+        pool.prewarm(widths=[16])   # compile before the timed region
+        daemon = SolveDaemon(pool, window_s=0.05).start()
+        try:
+            t0 = time.perf_counter()
+            tickets = [daemon.submit("flagship", Y[:, j])
+                       for j in range(n_requests)]
+            results = [t.wait(timeout=120.0) for t in tickets]
+            t_pack = time.perf_counter() - t0
+            st = daemon.stats()
+        finally:
+            daemon.drain(timeout=10.0)
+        # the race only counts if the daemon solved the same systems
+        err = max(float(_np.max(_np.abs(results[j]["x"] - oracles[j])))
+                  for j in range(n_requests))
+        return {"K": 16, "requests": n_requests, "niter": niter,
+                "shape": [N, N], "nblk": nblk,
+                "solves_per_sec": _sig3(n_requests / t_pack),
+                "sequential_solves_per_sec": _sig3(n_requests / t_seq),
+                "speedup_vs_sequential": _sig3(t_seq / t_pack),
+                "wait_p50_s": _sig3(st["wait_p50_s"]),
+                "wait_p99_s": _sig3(st["wait_p99_s"]),
+                "fill_mean": _sig3(st["fill_mean"]),
+                "batches": st["batches"],
+                "daemon_vs_sequential_max_abs_diff": _sig3(err)}
+    except Exception as e:  # the race must never cost the headline
+        return {"error": repr(e)[:300]}
+
+
 def _hier_race_row():
     """Hierarchical-vs-flat race (round 11 acceptance): declare the 8
     virtual devices a 2x4 hybrid fabric and run one pencil transpose
@@ -957,6 +1029,16 @@ def child_main():
         _progress("batched-throughput race (block-CGLS vs sequential)")
         batched = _batched_race_row()
 
+    # serving race (serving PR): 32 single-RHS requests through the
+    # continuous-batching daemon vs sequential fused solves; every
+    # CPU-sim round, BENCH_SERVING_PYLOPS_MPI_TPU=1 forces it on
+    # hardware too
+    serving_row = None
+    serving_env = os.environ.get("BENCH_SERVING_PYLOPS_MPI_TPU", "")
+    if serving_env != "0" and (not on_tpu or serving_env == "1"):
+        _progress("serving race (packed daemon vs sequential)")
+        serving_row = _serving_race_row()
+
     # hierarchical-vs-flat race (round 11): per-fabric DCN bytes on
     # the simulated 2x4 hybrid, every CPU-sim round;
     # BENCH_HIER_PYLOPS_MPI_TPU=1 forces it on hardware too
@@ -1114,6 +1196,7 @@ def child_main():
         **({"bf16_race": bf16_race} if bf16_race else {}),
         **({"tune_race": tune_race} if tune_race else {}),
         **({"batched": batched} if batched else {}),
+        **({"serving": serving_row} if serving_row else {}),
         **({"hierarchical_vs_flat": hier_race} if hier_race else {}),
         **({"selfcheck": selfcheck} if selfcheck is not None else {}),
         **({"cpu_breakdown": cpu_breakdown} if cpu_breakdown else {}),
@@ -1327,7 +1410,7 @@ def _merge_tpu_cache(result, root=None):
                              "degraded", "tpu_error", "components",
                              "cpu_breakdown", "flagship_1dev_cpu",
                              "roofline", "f32", "bf16", "plan",
-                             "tune_race", "batched",
+                             "tune_race", "batched", "serving",
                              "hierarchical_vs_flat")
                             if k in result}
                 result = dict(r)
@@ -1346,6 +1429,10 @@ def _merge_tpu_cache(result, root=None):
                 # TPU headline
                 if cpu_live.get("batched") is not None:
                     result["batched"] = cpu_live["batched"]
+                # and the serving race: live daemon throughput +
+                # time-in-queue that rides every compact line
+                if cpu_live.get("serving") is not None:
+                    result["serving"] = cpu_live["serving"]
                 # and the hierarchical DCN-byte race: a live CPU-sim
                 # attribution that must ride every compact line
                 if cpu_live.get("hierarchical_vs_flat") is not None:
@@ -1637,6 +1724,27 @@ def _sentinel_check(result, history, tolerance=0.15):
     verdict.update(fresh=round(float(fresh), 4), ratio=round(ratio, 4),
                    status="regressed" if regressed else "ok",
                    regressed=regressed)
+
+    # serving-throughput sub-verdict (serving PR): the packed daemon's
+    # solves/sec rides the same bucketed-median rule. Rounds banked
+    # before the serving row existed carry no number, so the sub-check
+    # silently stands down until history accrues — it can only trip
+    # against rounds that actually measured the daemon.
+    def _srv_rate(row):
+        s = row.get("serving") or {}
+        v = s.get("solves_per_sec")
+        return float(v) if isinstance(v, (int, float)) and v > 0 else None
+    fresh_srv = _srv_rate(result)
+    hist_srv = [v for v in (_srv_rate(h) for h in rows) if v is not None]
+    if fresh_srv is not None and hist_srv:
+        base = statistics.median(hist_srv)
+        srv_reg = fresh_srv < base * (1.0 - tolerance)
+        verdict["serving"] = {"fresh": round(fresh_srv, 4),
+                              "baseline": round(base, 4),
+                              "ratio": round(fresh_srv / base, 4),
+                              "regressed": srv_reg}
+        if srv_reg:
+            verdict.update(status="regressed", regressed=True)
     return verdict
 
 
@@ -1736,6 +1844,15 @@ def _compact_line(result):
             if bt.get(k) is not None}
     elif bt.get("error"):
         compact["batched"] = {"error": bt["error"][:120]}
+    srv = result.get("serving") or {}
+    if srv and not srv.get("error"):
+        compact["serving"] = {
+            k: srv.get(k) for k in
+            ("solves_per_sec", "speedup_vs_sequential",
+             "wait_p50_s", "wait_p99_s", "K")
+            if srv.get(k) is not None}
+    elif srv.get("error"):
+        compact["serving"] = {"error": srv["error"][:120]}
     tr = result.get("tune_race") or {}
     if tr and not tr.get("error"):
         compact["tune_race"] = {

@@ -73,7 +73,7 @@ PAGES = {
         ("Batched (block-Krylov and vmap-over-parameters)",
          "pylops_mpi_tpu",
          ["block_cg", "block_cgls", "block_cg_segmented",
-          "batched_solve"]),
+          "batched_solve", "batched_cache_info"]),
         ("Eigenvalues", "pylops_mpi_tpu", ["power_iteration"]),
     ],
     "resilience": [
@@ -91,7 +91,9 @@ PAGES = {
           "maybe_start_heartbeat", "start_heartbeat", "stop_heartbeat",
           "HeartbeatWriter", "read_heartbeat", "heartbeat_interval",
           "watched_call", "WatchdogTimeout", "watchdog_mode",
-          "watchdog_enabled", "watchdog_timeout"]),
+          "watchdog_enabled", "watchdog_timeout",
+          "request_drain", "drain_requested", "reset_drain",
+          "install_sigterm_drain"]),
         ("Job supervisor (launch, classify, shrink, relaunch)",
          "pylops_mpi_tpu.resilience.supervisor",
          ["launch_job", "JobResult", "Failure", "WorkerHandle",
@@ -165,7 +167,7 @@ PAGES = {
          ["metrics_mode", "metrics_enabled", "metrics_file",
           "metrics_interval", "inc", "set_gauge", "observe", "timer",
           "snapshot", "clear_metrics", "write_snapshot",
-          "read_snapshot"]),
+          "read_snapshot", "hist_quantiles"]),
         ("Cross-worker trace aggregation",
          "pylops_mpi_tpu.diagnostics.aggregate",
          ["load_events", "guess_rank", "collective_entries",
@@ -176,7 +178,7 @@ PAGES = {
         ("Plan seam", "pylops_mpi_tpu.tuning.plan",
          ["Plan", "get_plan", "tune_mode", "tune_enabled", "plan_key",
           "shape_bucket", "chunk_hint", "record_chunk_plan",
-          "applied_provenance"]),
+          "applied_provenance", "cached_batch_widths"]),
         ("Tuning spaces", "pylops_mpi_tpu.tuning.space",
          ["Axis", "TuningSpace", "register_space", "space_for",
           "candidates", "rank", "default_params"]),
@@ -185,7 +187,24 @@ PAGES = {
           "tune_margin"]),
         ("Plan cache", "pylops_mpi_tpu.tuning.cache",
          ["cache_path", "lookup", "store", "load_plans",
-          "clear_memory"]),
+          "cached_keys", "clear_memory"]),
+    ],
+    "serving": [
+        ("Warm-executable pool", "pylops_mpi_tpu.serving.engine",
+         ["k_buckets", "bucket_for", "FamilySpec", "BlockOutcome",
+          "WarmPool"]),
+        ("Admission queue and continuous batcher",
+         "pylops_mpi_tpu.serving.queue",
+         ["queue_bound", "batch_window_s", "QueueFull", "Ticket",
+          "SolveRequest", "AdmissionQueue", "pack", "Dispatcher"]),
+        ("Durable request spool", "pylops_mpi_tpu.serving.spool",
+         ["init_spool", "enqueue", "claim", "complete", "fail",
+          "recover_claimed", "read_result", "result_ids",
+          "pending_count", "claimed_count", "request_drain",
+          "drain_requested", "Claim"]),
+        ("Serve-forever deployment", "pylops_mpi_tpu.serving.service",
+         ["drain_timeout_s", "SolveDaemon", "worker_main",
+          "serve_job"]),
     ],
     "models": [
         ("Model workflows", "pylops_mpi_tpu.models",
@@ -207,6 +226,7 @@ PAGE_TITLES = {
     "diagnostics": "Diagnostics and observability",
     "resilience": "Resilience and fault injection",
     "tuning": "Autotuning",
+    "serving": "Serving (always-on solve service)",
     "models": "Model workflows",
 }
 

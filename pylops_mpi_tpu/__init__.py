@@ -38,7 +38,7 @@ from .solvers.basic import CG, CGLS, cg, cgls, clear_fused_cache
 from .solvers.sparsity import ISTA, FISTA, ista, fista
 from .solvers.segmented import cg_segmented, cgls_segmented
 from .solvers.block import (block_cg, block_cgls, block_cg_segmented,
-                            batched_solve)
+                            batched_solve, batched_cache_info)
 from .solvers.eigs import power_iteration
 from .resilience import resilient_solve
 from .utils.dottest import dottest
@@ -56,5 +56,6 @@ from . import waveeqprocessing
 from . import optimization
 from . import plotting
 from . import models
+from . import serving
 
 __version__ = "0.1.0"

@@ -52,11 +52,12 @@ import json
 import os
 import threading
 import time
-from typing import Dict, Optional
+from collections import deque
+from typing import Dict, Optional, Sequence
 
 __all__ = ["metrics_mode", "metrics_enabled", "metrics_file",
            "metrics_interval", "inc", "collective_bytes", "set_gauge",
-           "observe", "timer",
+           "observe", "timer", "hist_quantiles",
            "snapshot", "clear_metrics", "write_snapshot",
            "read_snapshot", "SNAPSHOT_SCHEMA"]
 
@@ -118,6 +119,13 @@ _GAUGES: Dict[str, float] = {}
 # job_report.json) need "how long / how many, roughly", and a fixed
 # 5-number summary keeps every beat O(registry size), never O(samples)
 _HISTS: Dict[str, Dict[str, float]] = {}
+# a bounded ring of RECENT raw samples per histogram, kept OUT of the
+# snapshot (schema unchanged, beats stay O(registry size)): the serving
+# layer's backpressure report wants p50/p99 time-in-queue, which a
+# 5-number summary cannot give. 512 samples bounds memory while keeping
+# tail quantiles meaningful over the recent window.
+_HSAMPLES: Dict[str, "deque"] = {}
+_HSAMPLES_MAX = 512
 
 
 def inc(name: str, value: float = 1) -> None:
@@ -176,7 +184,34 @@ def observe(name: str, value: float) -> None:
             h["min"] = min(h["min"], value)
             h["max"] = max(h["max"], value)
             h["last"] = value
+        ring = _HSAMPLES.get(name)
+        if ring is None:
+            ring = _HSAMPLES[name] = deque(maxlen=_HSAMPLES_MAX)
+        ring.append(value)
     _maybe_start_writer()
+
+
+def hist_quantiles(name: str,
+                   qs: Sequence[float] = (0.5, 0.99)
+                   ) -> Optional[Dict[str, float]]:
+    """Quantiles over histogram ``name``'s recent-sample ring (last
+    ``512`` observations): ``{"p50": ..., "p99": ...}`` by default, or
+    ``None`` when the histogram has no samples (or metrics are off).
+    Nearest-rank on the sorted window — good enough for the serving
+    backpressure report, and O(window) only when asked, never per
+    observation."""
+    with _LOCK:
+        ring = _HSAMPLES.get(name)
+        samples = sorted(ring) if ring else None
+    if not samples:
+        return None
+    n = len(samples)
+    out = {}
+    for q in qs:
+        q = min(1.0, max(0.0, float(q)))
+        idx = min(n - 1, max(0, int(round(q * (n - 1)))))
+        out[f"p{q * 100:g}"] = samples[idx]
+    return out
 
 
 class _Timer:
@@ -238,6 +273,7 @@ def clear_metrics() -> None:
         _COUNTERS.clear()
         _GAUGES.clear()
         _HISTS.clear()
+        _HSAMPLES.clear()
 
 
 # ------------------------------------------------- snapshot persistence

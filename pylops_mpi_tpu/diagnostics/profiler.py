@@ -79,6 +79,11 @@ STAGE_BUDGETS: Dict[str, Dict[str, Optional[int]]] = {
     "multihost_init":  {"tpu": 300, "rehearse": 120},
     "checkpoint_io":   {"tpu": 600, "rehearse": 300},
     "multihost_chaos": {"tpu": 900, "rehearse": 600},
+    # serving-daemon stages (serving/queue.py dispatcher wraps every
+    # packed batch solve in a DeadlineRunner with this budget; the CI
+    # serve-forever smoke uses serve_smoke as its job timeout)
+    "serve_batch":     {"tpu": 120, "rehearse": 60},
+    "serve_smoke":     {"tpu": 900, "rehearse": 600},
 }
 
 _ENV_NAMES = {

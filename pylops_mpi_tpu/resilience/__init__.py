@@ -43,7 +43,9 @@ from .driver import resilient_solve, ResilientResult
 from .elastic import (WatchdogTimeout, watched_call, watchdog_mode,
                       watchdog_enabled, start_heartbeat, stop_heartbeat,
                       maybe_start_heartbeat, worker_config,
-                      elastic_initialize, WorkerConfig)
+                      elastic_initialize, WorkerConfig,
+                      request_drain, drain_requested, reset_drain,
+                      install_sigterm_drain)
 from .supervisor import launch_job, JobResult, Failure, WorkerHandle
 
 __all__ = ["elastic", "faults", "retry", "status", "supervisor",
@@ -54,4 +56,6 @@ __all__ = ["elastic", "faults", "retry", "status", "supervisor",
            "watchdog_enabled", "start_heartbeat", "stop_heartbeat",
            "maybe_start_heartbeat", "worker_config",
            "elastic_initialize", "WorkerConfig",
+           "request_drain", "drain_requested", "reset_drain",
+           "install_sigterm_drain",
            "launch_job", "JobResult", "Failure", "WorkerHandle"]

@@ -63,7 +63,9 @@ __all__ = ["heartbeat_interval", "heartbeat_file", "HeartbeatWriter",
            "start_heartbeat", "stop_heartbeat", "maybe_start_heartbeat",
            "read_heartbeat", "WatchdogTimeout", "watchdog_mode",
            "watchdog_enabled", "watchdog_timeout", "watched_call",
-           "WorkerConfig", "worker_config", "elastic_initialize"]
+           "WorkerConfig", "worker_config", "elastic_initialize",
+           "request_drain", "drain_requested", "reset_drain",
+           "install_sigterm_drain"]
 
 
 # ------------------------------------------------------------ heartbeats
@@ -298,6 +300,61 @@ def watched_call(fn: Callable, *args, stage: str,
     if ok:
         return payload
     raise payload
+
+
+# -------------------------------------------------------- drain signal
+# SIGTERM semantics for serve-forever workers (serving/service.py):
+# the deployment's stop is a DRAIN, not a kill — finish in-flight
+# batches, refuse new claims, then exit 0. A signal handler can only
+# run on the main thread; the serving loops poll this event instead.
+_DRAIN = threading.Event()
+_prev_sigterm: Any = None
+
+
+def request_drain() -> None:
+    """Ask this process's serving loops to drain and exit (idempotent;
+    also callable directly, e.g. from tests or an admin endpoint)."""
+    if not _DRAIN.is_set():
+        _DRAIN.set()
+        _trace.event("resilience.drain_requested", cat="resilience",
+                     pid=os.getpid())
+        _metrics.inc("serve.drain_requests")
+
+
+def drain_requested() -> bool:
+    """Whether a drain has been requested for this process."""
+    return _DRAIN.is_set()
+
+
+def reset_drain() -> None:
+    """Clear the drain flag (test isolation; a served process never
+    un-drains)."""
+    _DRAIN.clear()
+
+
+def install_sigterm_drain() -> bool:
+    """Route SIGTERM to :func:`request_drain` (chaining any previous
+    handler). Returns False — leaving signal disposition untouched —
+    when not on the main thread, where Python forbids ``signal.signal``.
+    Idempotent: a second install keeps the first chain."""
+    import signal as _signal
+    global _prev_sigterm
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    current = _signal.getsignal(_signal.SIGTERM)
+    if getattr(current, "_pylops_drain", False):
+        return True  # already installed
+
+    def _handler(signum, frame):
+        request_drain()
+        if callable(current) and current not in (
+                _signal.SIG_IGN, _signal.SIG_DFL):
+            current(signum, frame)
+
+    _handler._pylops_drain = True
+    _prev_sigterm = current
+    _signal.signal(_signal.SIGTERM, _handler)
+    return True
 
 
 # ----------------------------------------------------- worker bring-up

@@ -263,6 +263,8 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
                logdir: Optional[str] = None,
                on_poll: Optional[Callable[[int, List[WorkerHandle]],
                                           None]] = None,
+               on_relaunch: Optional[Callable[[int, Failure],
+                                              None]] = None,
                python: Optional[str] = None) -> JobResult:
     """Launch ``num_workers`` supervised worker processes and babysit
     them to completion, relaunching on a shrunk world after failures.
@@ -295,6 +297,14 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
     ``on_poll(attempt, workers)`` runs every poll tick — the chaos
     tests use it to SIGSTOP a worker mid-epoch; production callers can
     use it for progress reporting.
+
+    ``on_relaunch(next_attempt, failure)`` runs after a failed attempt
+    has been killed and before its relaunch starts — the serving layer
+    uses it to move the dead attempt's claimed-but-unfinished requests
+    back into the pending spool so no in-flight work is lost. A raising
+    hook is swallowed (recovery must not kill the supervisor); it is
+    NOT called for terminal failures (budget exhausted, job timeout) —
+    the caller still holds the final :class:`JobResult` for those.
 
     Worker env: inherits ``os.environ``, overlaid with ``env``, overlaid
     with the elastic contract (contract wins — a stale
@@ -426,6 +436,12 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
         if not slots or attempt >= max_relaunches:
             write_job_report(result)
             return result
+        if on_relaunch is not None:
+            try:
+                on_relaunch(attempt + 1, failure)
+            except Exception:
+                pass
+        _metrics.inc("supervisor.relaunches")
         _trace.event("supervisor.relaunch", cat="resilience",
                      attempt=attempt + 1, world=len(slots),
                      slots=list(slots))

@@ -1,0 +1,263 @@
+"""Warm-executable pool: compiled block programs, ready before traffic.
+
+The serving daemon's latency floor is compile time — a cold
+(operator family, K) pair pays seconds of XLA compilation on the
+request that first needs it. The :class:`WarmPool` removes that cliff:
+
+- **Families** — a :class:`FamilySpec` names one operator instance plus
+  its solver configuration (``cg``/``cgls``, ``niter``, ``tol``,
+  ``damp``). The SAME instance is used for every solve and every
+  prewarm, so the fused-executable cache in ``solvers/basic.py``
+  (keyed on ``id(Op)``) hits by construction.
+- **K buckets** — incoming fills are rounded up to the next width in
+  ``PYLOPS_MPI_TPU_SERVE_K_BUCKETS`` (default ``1,2,4,8,16``) and the
+  short side padded with zero columns. Padding is EXACT: block-Krylov
+  recurrences are column-independent (every scalar is a per-column
+  ``col_dot``), a zero column's residual is zero so it freezes at
+  iteration 0, and the padded program is the same compiled executable
+  the full bucket uses — so K distinct fills share one program instead
+  of K programs.
+- **Prewarm** — at startup the pool consults the tuning plan cache
+  (:func:`pylops_mpi_tpu.tuning.plan.cached_batch_widths`) for the
+  block widths real traffic measured plans at, and compiles those
+  (falling back to every configured bucket when there is no history) by
+  running a zero-RHS solve per (family, K): zero data means zero
+  initial residual, the fused ``while_loop`` condition is false at
+  entry, and the call compiles the program without executing a single
+  iteration.
+
+Per-column robustness (one tenant must not hurt its batch-mates) is
+inherited from the block solvers: each column freezes on its OWN
+convergence test, and with ``PYLOPS_MPI_TPU_GUARDS=on`` a breakdown
+column is frozen with a per-column verdict while the rest run to their
+own finish. Serve deployments should run with guards on — without
+them a non-finite column collapses the shared loop condition for the
+whole batch (see ``docs/serving.md#poisoned-columns``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..distributedarray import DistributedArray
+from ..diagnostics import metrics as _metrics
+from ..diagnostics import trace as _trace
+
+__all__ = ["k_buckets", "bucket_for", "FamilySpec", "BlockOutcome",
+           "WarmPool"]
+
+_DEFAULT_BUCKETS = (1, 2, 4, 8, 16)
+
+
+def k_buckets() -> Tuple[int, ...]:
+    """``PYLOPS_MPI_TPU_SERVE_K_BUCKETS`` parsed to a sorted tuple of
+    distinct positive widths (default ``(1, 2, 4, 8, 16)``; malformed
+    tokens are dropped, an empty survivor set falls back to the
+    default — a typo must not leave the pool bucketless)."""
+    raw = os.environ.get("PYLOPS_MPI_TPU_SERVE_K_BUCKETS", "")
+    vals = set()
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if tok.isdigit() and int(tok) >= 1:
+            vals.add(int(tok))
+    return tuple(sorted(vals)) if vals else _DEFAULT_BUCKETS
+
+
+def bucket_for(count: int, buckets: Optional[Sequence[int]] = None) -> int:
+    """Smallest configured bucket that fits ``count`` columns (the
+    largest bucket when ``count`` overflows them all — the caller is
+    expected to chunk at the max bucket, which the dispatcher does by
+    construction)."""
+    bs = tuple(buckets) if buckets else k_buckets()
+    for b in bs:
+        if b >= count:
+            return b
+    return bs[-1]
+
+
+@dataclass(frozen=True)
+class FamilySpec:
+    """One servable operator family: the operator INSTANCE (reused for
+    every solve so the fused cache hits), the engine and its fixed
+    solve parameters. ``tol=0.0`` is the bit-for-bit setting: it pins
+    every column to the full ``niter`` schedule, so a packed solve
+    equals its single-RHS oracle exactly."""
+    name: str
+    operator: object
+    solver: str = "cgls"          # "cg" | "cgls"
+    niter: int = 10
+    tol: float = 0.0
+    damp: float = 0.0
+    dtype: object = np.float32
+
+    def __post_init__(self):
+        if self.solver not in ("cg", "cgls"):
+            raise ValueError(
+                f"solver={self.solver!r}: expected 'cg' or 'cgls'")
+
+    @property
+    def nrows(self) -> int:
+        return int(self.operator.shape[0])
+
+
+@dataclass
+class BlockOutcome:
+    """One packed solve, already sliced back to the real fill: ``x``
+    is ``(M, k)`` (padding columns dropped), ``statuses`` one name per
+    real column (``converged``/``maxiter``/``breakdown``)."""
+    x: np.ndarray
+    iiter: int
+    statuses: Tuple[str, ...]
+    k: int                        # real fill
+    bucket: int                   # compiled width actually run
+    wall_s: float
+
+
+def _column_statuses(kold: np.ndarray, tol: float) -> Tuple[str, ...]:
+    """Per-column verdict from the final per-column residual scalars:
+    non-finite → breakdown, at/under tolerance → converged, else
+    maxiter. (With guards on the solver additionally froze breakdown
+    columns in-loop; this classification agrees with the recorded
+    verdicts for the finite/non-finite split.)"""
+    kold = np.atleast_1d(np.asarray(kold))
+    out = []
+    for v in kold:
+        if not np.isfinite(v):
+            out.append("breakdown")
+        elif v < tol:
+            out.append("converged")
+        else:
+            out.append("maxiter")
+    return tuple(out)
+
+
+class WarmPool:
+    """Registry of servable families + the packed-solve entry point.
+
+    Thread-safe for one solve at a time (an internal lock — the
+    dispatcher is single-threaded, but drain paths and tests may race
+    it). ``warmed`` records every (family, bucket) pair that has been
+    through a compile, whether by :meth:`prewarm` or by live traffic.
+    """
+
+    def __init__(self, buckets: Optional[Sequence[int]] = None):
+        self._families: Dict[str, FamilySpec] = {}
+        self._buckets = tuple(sorted(set(buckets))) if buckets \
+            else k_buckets()
+        self._lock = threading.Lock()
+        self.warmed: set = set()
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        return self._buckets
+
+    @property
+    def k_max(self) -> int:
+        return self._buckets[-1]
+
+    def register(self, spec: FamilySpec) -> FamilySpec:
+        if spec.name in self._families:
+            raise ValueError(f"family {spec.name!r} already registered")
+        self._families[spec.name] = spec
+        return spec
+
+    def family(self, name: str) -> FamilySpec:
+        try:
+            return self._families[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown operator family {name!r}; registered: "
+                f"{sorted(self._families)}") from None
+
+    def families(self) -> Tuple[str, ...]:
+        return tuple(sorted(self._families))
+
+    # ------------------------------------------------------------ solve
+    def solve(self, name: str, Y: np.ndarray) -> BlockOutcome:
+        """Solve ``Y``'s ``k`` columns as one padded block program of
+        the next-larger bucket width. ``Y`` is ``(N, k)`` (a 1-D ``y``
+        is treated as ``k=1``)."""
+        from ..solvers.block import block_cg, block_cgls
+        spec = self.family(name)
+        Y = np.asarray(Y, dtype=np.dtype(spec.dtype))
+        if Y.ndim == 1:
+            Y = Y[:, None]
+        N, k = Y.shape
+        if N != spec.nrows:
+            raise ValueError(
+                f"family {name!r} expects data length {spec.nrows}, "
+                f"got {N}")
+        bucket = bucket_for(k, self._buckets)
+        if k > bucket:
+            raise ValueError(
+                f"fill {k} exceeds the largest bucket {bucket}; "
+                "dispatch at most k_max columns per batch")
+        if bucket > k:
+            Y = np.concatenate(
+                [Y, np.zeros((N, bucket - k), dtype=Y.dtype)], axis=1)
+        yb = DistributedArray(global_shape=(N, bucket),
+                              dtype=np.dtype(spec.dtype))
+        yb[:] = Y
+        t0 = time.perf_counter()
+        with self._lock, _trace.span("serve.pool_solve", cat="serving",
+                                     family=name, fill=k, bucket=bucket,
+                                     solver=spec.solver):
+            if spec.solver == "cg":
+                xb, iiter, cost = block_cg(
+                    spec.operator, yb, niter=spec.niter, tol=spec.tol)
+                kold = np.asarray(cost)[-1] ** 2
+            else:
+                xb, _istop, iiter, kold, _r2, _cost = block_cgls(
+                    spec.operator, yb, niter=spec.niter,
+                    damp=spec.damp, tol=spec.tol)
+        wall = time.perf_counter() - t0
+        self.warmed.add((name, bucket))
+        _metrics.inc("serve.pool.solves")
+        _metrics.observe("serve.batch.fill", k / bucket)
+        x = np.asarray(xb.array)[:, :k]
+        statuses = _column_statuses(kold, spec.tol)[:k]
+        return BlockOutcome(x=x, iiter=int(iiter), statuses=statuses,
+                            k=k, bucket=bucket, wall_s=wall)
+
+    # ---------------------------------------------------------- prewarm
+    def prewarm(self, names: Optional[Sequence[str]] = None,
+                widths: Optional[Sequence[int]] = None) -> Dict:
+        """Compile (family, bucket) programs before traffic arrives.
+
+        Bucket choice per family, in order: the explicit ``widths``
+        argument; else the plan cache's banked block widths for the
+        operator's family name (``tuning.plan.cached_batch_widths`` —
+        a width that earned a measured plan is a width traffic used),
+        rounded up to configured buckets; else EVERY configured bucket
+        (no history → assume any fill can arrive). Each compile is a
+        zero-RHS solve: the loop condition is false at entry, so the
+        cost is exactly one compilation, zero iterations. Returns
+        ``{family: [buckets compiled]}``."""
+        from ..tuning.plan import cached_batch_widths
+        report: Dict[str, list] = {}
+        for name in (names if names is not None else self.families()):
+            spec = self.family(name)
+            if widths is not None:
+                want = [bucket_for(w, self._buckets) for w in widths]
+            else:
+                hist = cached_batch_widths(type(spec.operator).__name__)
+                want = [bucket_for(w, self._buckets)
+                        for w in hist if w <= self.k_max]
+                if not want:
+                    want = list(self._buckets)
+            done = []
+            for b in sorted(set(want)):
+                with _trace.span("serve.prewarm", cat="serving",
+                                 family=name, bucket=b):
+                    self.solve(name, np.zeros((spec.nrows, b),
+                                              dtype=np.dtype(spec.dtype)))
+                done.append(b)
+                _metrics.inc("serve.pool.prewarmed")
+            report[name] = done
+        return report

@@ -36,6 +36,7 @@ guarantee. See docs/batching.md for when each axis wins.
 
 from __future__ import annotations
 
+import os
 from collections import OrderedDict, namedtuple
 from functools import partial
 from typing import Optional, Sequence
@@ -52,7 +53,7 @@ from .basic import (_DONATE_X0, _donate_copy, _get_fused, _i32,
                     _mp_floor, _reject, _step_scalar, _vdtype, _vkey)
 
 __all__ = ["block_cg", "block_cgls", "block_cg_segmented",
-           "batched_solve", "BatchedResult"]
+           "batched_solve", "BatchedResult", "batched_cache_info"]
 
 
 def _bdot(u: DistributedArray, v: DistributedArray):
@@ -621,7 +622,28 @@ BatchedResult.__doc__ = (
     "until every problem's loop exits.")
 
 _BATCHED_CACHE: "OrderedDict" = OrderedDict()
-_BATCHED_CACHE_MAX = 8
+
+
+def _batched_cache_max() -> int:
+    """``PYLOPS_MPI_TPU_BATCHED_CACHE`` — capacity of the per-family
+    compiled-executable LRU (default 8, floored at 1 so a typo cannot
+    disable caching entirely)."""
+    try:
+        v = int(os.environ.get("PYLOPS_MPI_TPU_BATCHED_CACHE", "8"))
+    except ValueError:
+        v = 8
+    return max(1, v)
+
+
+def batched_cache_info() -> dict:
+    """Introspection for the warm pool / tests: the batched-solve LRU's
+    ``{"size", "max", "families"}`` where ``families`` lists the cached
+    ``(solver, niter, B, op)`` heads newest-last. Hit/miss traffic is
+    on the metrics counters ``solver.batched.cache.hit`` / ``.miss``
+    (the ``tuning.cache.*`` idiom)."""
+    return {"size": len(_BATCHED_CACHE),
+            "max": _batched_cache_max(),
+            "families": [k[:4] for k in _BATCHED_CACHE]}
 
 
 def _aval_key(t):
@@ -715,6 +737,8 @@ def batched_solve(factory, params: Sequence, ys: Sequence,
            _vkey(ys[0]), _vkey(x0s[0]), donate,
            telemetry.telemetry_signature())
     jfn = _BATCHED_CACHE.get(key)
+    _metrics.inc("solver.batched.cache.hit" if jfn is not None
+                 else "solver.batched.cache.miss")
     with _trace.span(f"solver.batched_{solver}", cat="solver",
                      op=type(Op0).__name__, shape=Op0.shape, family=B,
                      niter=niter, tol=tol, compiled=jfn is not None,
@@ -729,7 +753,7 @@ def batched_solve(factory, params: Sequence, ys: Sequence,
             jfn = jax.jit(jax.vmap(one, in_axes=(0, 0, 0, None, None)),
                           donate_argnums=donate)
             _BATCHED_CACHE[key] = jfn
-            if len(_BATCHED_CACHE) > _BATCHED_CACHE_MAX:
+            if len(_BATCHED_CACHE) > _batched_cache_max():
                 _BATCHED_CACHE.popitem(last=False)
         else:
             _BATCHED_CACHE.move_to_end(key)

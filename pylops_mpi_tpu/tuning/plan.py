@@ -54,7 +54,8 @@ from . import space as _space
 
 __all__ = ["Plan", "tune_mode", "tune_enabled", "plan_key",
            "shape_bucket", "get_plan", "chunk_hint",
-           "record_chunk_plan", "applied_provenance", "reset_applied"]
+           "record_chunk_plan", "applied_provenance", "reset_applied",
+           "cached_batch_widths"]
 
 _MODES = ("off", "on", "auto")
 _warned_mode = False
@@ -162,6 +163,27 @@ def plan_key(op: str, shape, dtype=None, n_dev: Optional[int] = None,
     if extra and extra.get("topology"):
         key += f"|t{extra['topology']}"
     return key
+
+
+def cached_batch_widths(op: str, path: Optional[str] = None) -> list:
+    """Block widths K with a banked plan for operator family ``op``
+    (sorted, deduped; ``1`` for keys without a ``|b{K}`` segment). The
+    serving warm pool's startup consult: a width that earned a measured
+    plan is a width real traffic used, so its (family, K) program is
+    compiled before the first request instead of on it. An unparseable
+    segment is skipped — a foreign cache entry must not break serving
+    bring-up."""
+    widths = set()
+    prefix = op + "|"
+    for key in _cache.cached_keys(path):
+        if not key.startswith(prefix):
+            continue
+        k = 1
+        for seg in key.split("|")[1:]:
+            if len(seg) > 1 and seg[0] == "b" and seg[1:].isdigit():
+                k = int(seg[1:])
+        widths.add(k)
+    return sorted(widths)
 
 
 def _context(op: str, shape, dtype, n_dev, axes, extra) -> Dict:

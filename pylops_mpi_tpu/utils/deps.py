@@ -241,6 +241,26 @@ KNOBS = [
     ("PYLOPS_MPI_TPU_METRICS_INTERVAL", "seconds", "5.0",
      "diagnostics/metrics.py",
      "snapshot-write cadence of the background metrics writer"),
+    ("PYLOPS_MPI_TPU_BATCHED_CACHE", "int>=1", "8",
+     "solvers/block.py",
+     "batched_solve per-family compiled-executable LRU capacity "
+     "(hit/miss counters: solver.batched.cache.*)"),
+    ("PYLOPS_MPI_TPU_SERVE_QUEUE", "int>=1", "1024",
+     "serving/queue.py",
+     "admission-queue depth bound; a submit past it is rejected "
+     "(QueueFull) — the serving backpressure knob"),
+    ("PYLOPS_MPI_TPU_SERVE_WINDOW_MS", "milliseconds", "10.0",
+     "serving/queue.py",
+     "batch-formation window: how long the dispatcher holds an "
+     "undersized batch open for late arrivals"),
+    ("PYLOPS_MPI_TPU_SERVE_K_BUCKETS", "csv of ints", "1,2,4,8,16",
+     "serving/engine.py",
+     "block-width buckets the warm pool compiles and the packer "
+     "rounds ragged fills up to"),
+    ("PYLOPS_MPI_TPU_SERVE_DRAIN_TIMEOUT", "seconds", "30.0",
+     "serving/service.py",
+     "graceful-drain bound: how long SIGTERM/drain waits for "
+     "in-flight batches before giving up"),
 ]
 
 

@@ -1,0 +1,77 @@
+"""Worker for the serve-forever smoke (ISSUE 12).
+
+Launched by ``serving.serve_job`` (see
+``tests/test_serving.py::test_serve_forever_smoke_survives_worker_kill``).
+Each worker is an INDEPENDENT serving replica: it builds the flagship
+block-diagonal family deterministically (seed 3 — the in-test oracles
+build the identical matrices), registers it in a
+:class:`~pylops_mpi_tpu.serving.WarmPool`, and runs
+:func:`~pylops_mpi_tpu.serving.worker_main` against the shared spool
+named by ``PYLOPS_SERVE_SPOOL``. No gloo / jax.distributed: replicas
+coordinate only through the spool's rename atomicity, so a SIGSTOP'd
+peer cannot wedge a survivor inside a collective.
+
+Exit 0 = drained clean (the spool's DRAIN marker landed and pending is
+empty). The supervisor's heartbeat/staleness machinery sees this
+worker exactly like any other supervised job.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8"
+                           ).strip()
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import numpy as np  # noqa: E402
+
+NBLK = 8
+NBLOCK = 48
+NITER = 20
+
+
+def build_pool(mesh=None):
+    """The flagship family, bit-identical to the test's oracle build:
+    seed-3 SPD blocks, f32, tol=0 (full-schedule pin)."""
+    import pylops_mpi_tpu as pmt
+    from pylops_mpi_tpu.ops.local import MatrixMult
+    from pylops_mpi_tpu.serving import FamilySpec, WarmPool
+    rng = np.random.default_rng(3)
+    mats = []
+    for _ in range(NBLK):
+        m = rng.standard_normal((NBLOCK, NBLOCK)).astype(np.float32)
+        mats.append(np.eye(NBLOCK, dtype=np.float32) * 4
+                    + 0.3 * (m + m.T))
+    Op = pmt.MPIBlockDiag(
+        [MatrixMult(m, dtype=np.float32) for m in mats],
+        **({"mesh": mesh} if mesh is not None else {}))
+    pool = WarmPool()
+    pool.register(FamilySpec(name="flagship", operator=Op,
+                             solver="cgls", niter=NITER, tol=0.0))
+    return pool
+
+
+def main() -> None:
+    import pylops_mpi_tpu as pmt
+    from pylops_mpi_tpu.parallel.mesh import Mesh
+    from pylops_mpi_tpu.serving import worker_main
+
+    spool_dir = os.environ["PYLOPS_SERVE_SPOOL"]
+    mesh = Mesh(np.asarray(jax.local_devices()), ("sp",))
+    pmt.set_default_mesh(mesh)
+    pool = build_pool(mesh)
+    solved = worker_main(spool_dir, pool)
+    rank = os.environ.get("PYLOPS_MPI_TPU_PROCESS_ID", "?")
+    attempt = os.environ.get("PYLOPS_MPI_TPU_ATTEMPT", "?")
+    print(f"SERVE OK rank={rank} attempt={attempt} solved={solved}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -163,7 +163,11 @@ def _second_deriv_dense(n, sampling, kind, edge):
 
 
 @pytest.mark.parametrize("kind", ["forward", "backward", "centered"])
-@pytest.mark.parametrize("edge", [False, True])
+# edge=True second-derivative rows ride the CI legs that run this file
+# unfiltered (default matrix, test-ragged, test-overlap); slow-marked
+# for the tier-1 wall budget, same rule as the first-derivative rows
+@pytest.mark.parametrize("edge", [
+    False, pytest.param(True, marks=pytest.mark.slow)])
 @pytest.mark.parametrize("dims", [(30,), (16, 5)])
 def test_second_derivative(rng, kind, edge, dims):
     """Distributed matvec/rmatvec vs independent dense stencil matrix,
