@@ -39,6 +39,11 @@ PAGES = {
          ["all_to_all_resharding", "ring_halo_extend", "cart_halo_extend",
           "halo_slab", "ring_pass", "hier_pencil_transpose",
           "hier_psum_scatter", "hier_all_gather"]),
+        ("Bounded-memory resharding planner",
+         "pylops_mpi_tpu.parallel.reshard",
+         ["Layout", "ReshardStep", "ReshardPlan", "ReshardError",
+          "reshard_budget", "plan_reshard", "reshard", "place_replica",
+          "reshard_raw"]),
         ("Fabric topology", "pylops_mpi_tpu.parallel.topology",
          ["fabric_override", "axis_fabric", "mesh_fabrics", "is_hybrid",
           "hybrid_axes", "topology_key", "collective_fabric", "slice_map",
@@ -98,10 +103,17 @@ PAGES = {
          "pylops_mpi_tpu.resilience.supervisor",
          ["launch_job", "JobResult", "Failure", "WorkerHandle",
           "free_port"]),
+        ("In-place (no-checkpoint) elastic recovery",
+         "pylops_mpi_tpu.resilience.elastic",
+         ["ElasticReconfig", "inplace_mode", "inplace_armed",
+          "quorum_fraction", "reconfig_file", "pending_reconfig",
+          "apply_reconfig", "reform_mesh", "bank_carry", "banked_carry",
+          "clear_carry", "restore_carry"]),
         ("Fault injection (chaos seams)",
          "pylops_mpi_tpu.resilience.faults",
          ["arm", "disarm", "armed", "consume", "fault_signature",
-          "host_stall", "corrupt_plan_cache", "flaky"]),
+          "host_stall", "corrupt_plan_cache", "flaky",
+          "maybe_kill_reshard", "reset_reshard_steps", "reshard_steps"]),
     ],
     "local": [
         ("Local (per-shard) operators", "pylops_mpi_tpu.ops.local",

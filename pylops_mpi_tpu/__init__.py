@@ -40,6 +40,8 @@ from .solvers.segmented import cg_segmented, cgls_segmented
 from .solvers.block import (block_cg, block_cgls, block_cg_segmented,
                             batched_solve, batched_cache_info)
 from .solvers.eigs import power_iteration
+from .parallel.reshard import (Layout, ReshardError, plan_reshard,
+                               reshard_budget)
 from .resilience import resilient_solve
 from .utils.dottest import dottest
 from .plotting.plotting import plot_distributed_array, plot_local_arrays

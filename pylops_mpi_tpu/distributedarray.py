@@ -647,12 +647,19 @@ class DistributedArray:
     # ----------------------------------------------------- redistribution
     def redistribute(self, axis: int) -> "DistributedArray":
         """Change the sharded axis — the all-to-all pattern of
-        ref ``DistributedArray.py:463-522``, realised as a resharding
-        placement whose collective XLA schedules."""
+        ref ``DistributedArray.py:463-522``. Concrete arrays route
+        through the bounded-memory resharding planner
+        (:mod:`~pylops_mpi_tpu.parallel.reshard` — budget enforcement,
+        chunked steps, ici/dcn byte attribution); traced arrays keep
+        the original one-shot resharding placement so every existing
+        jitted call site's HLO is bit-identical."""
         if self._partition != Partition.SCATTER:
             raise ValueError("redistribute only applies to SCATTER arrays")
         if axis == self._axis:
             return self.copy()
+        if not _is_tracer(self._arr):
+            from .parallel import reshard as _reshard
+            return _reshard.reshard(self, axis=axis)
         out = DistributedArray._wrap(
             None, self, axis=axis,
             local_shapes=local_split(self._global_shape, self._n_shards,
@@ -663,14 +670,35 @@ class DistributedArray:
     def to_partition(self, partition: Partition,
                      axis: Optional[int] = None) -> "DistributedArray":
         """Convert between BROADCAST and SCATTER placements (the idiom at
-        ref ``FirstDerivative.py:130-131``)."""
+        ref ``FirstDerivative.py:130-131``). Concrete arrays go through
+        the resharding planner (see :meth:`redistribute`); traced
+        arrays keep the original placement path."""
         axis = self._axis if axis is None else axis
+        if not _is_tracer(self._arr):
+            from .parallel import reshard as _reshard
+            return _reshard.reshard(self, partition=partition, axis=axis)
         out = DistributedArray._wrap(
             None, self, partition=partition, axis=axis,
             local_shapes=local_split(self._global_shape, self._n_shards,
                                      partition, axis))
         out._arr = out._place(out._from_global(self._global()))
         return out
+
+    def reshard(self, *, mesh=None, partition: Optional[Partition] = None,
+                axis: Optional[int] = None, local_shapes=None,
+                budget=..., chunks: Optional[int] = None
+                ) -> "DistributedArray":
+        """Move to any new layout — partition, axis, ragged split,
+        and/or a different mesh (shrink/grow) — through the
+        bounded-memory planner; peak scratch never exceeds ``budget``
+        (default ``PYLOPS_MPI_TPU_RESHARD_BUDGET``). See
+        :func:`pylops_mpi_tpu.parallel.reshard.reshard`."""
+        from .parallel import reshard as _reshard
+        if budget is ...:
+            budget = _reshard._UNSET
+        return _reshard.reshard(self, mesh=mesh, partition=partition,
+                                axis=axis, local_shapes=local_shapes,
+                                budget=budget, chunks=chunks)
 
     # -------------------------------------------------------- ghost cells
     def _ghost_widths(self, cells_front, cells_back):

@@ -3,3 +3,6 @@ from .mesh import (make_mesh, make_mesh_2d, make_mesh_hybrid,
 from .partition import Partition, local_split
 from . import collectives
 from . import topology
+from . import reshard
+from .reshard import (Layout, ReshardError, ReshardPlan, ReshardStep,
+                      plan_reshard, place_replica, reshard_budget)

@@ -148,22 +148,20 @@ def all_to_all_resharding(x: jax.Array, mesh: Mesh,
 
     The implicit path (``jax.device_put`` with the new sharding) lets XLA
     pick the schedule; this explicit version pins a single
-    ``lax.all_to_all``. Requires both axes divisible by the mesh size —
-    violations raise here with the axis and mesh size named, instead of
-    the shape-mismatch ``lax.all_to_all`` would throw from deep inside
-    the traced kernel.
+    ``lax.all_to_all`` when both axes divide the mesh size. Round 13:
+    non-dividing axes no longer raise — they route through the
+    bounded-memory resharding planner
+    (:func:`~pylops_mpi_tpu.parallel.reshard.reshard_raw`), which only
+    refuses (``ReshardError``, naming the minimum budget that would
+    succeed) when ``PYLOPS_MPI_TPU_RESHARD_BUDGET`` makes the move
+    genuinely impossible.
     """
     axis_name = mesh.axis_names[0]
     n_dev = int(mesh.devices.size)
-    for ax in dict.fromkeys((old_axis, new_axis)):
-        if x.shape[ax] % n_dev:
-            raise ValueError(
-                f"all_to_all_resharding: axis {ax} of length "
-                f"{x.shape[ax]} is not divisible by the mesh size "
-                f"{n_dev}; pad the axis to a multiple of {n_dev} first "
-                "(the pencil kernels pad-and-crop, ops/fft.py) or use "
-                "the implicit resharding (device_put with the target "
-                "sharding)")
+    if any(x.shape[ax] % n_dev
+           for ax in dict.fromkeys((old_axis, new_axis))):
+        from .reshard import reshard_raw
+        return reshard_raw(x, mesh, old_axis, new_axis)
     in_spec = [None] * x.ndim
     in_spec[old_axis] = axis_name
     out_spec = [None] * x.ndim

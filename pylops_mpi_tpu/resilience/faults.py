@@ -41,11 +41,51 @@ from typing import Callable, Dict, Optional
 
 __all__ = ["arm", "disarm", "armed", "consume", "fault_signature",
            "inject_nan", "inject_stall", "host_stall",
-           "corrupt_plan_cache", "flaky"]
+           "corrupt_plan_cache", "flaky",
+           "maybe_kill_reshard", "reset_reshard_steps", "reshard_steps"]
 
 _LOCK = threading.Lock()
 _ARMED: Optional[Dict] = None
 _KINDS = ("nan", "stall")
+
+# ------------------------------------------- kill-mid-reshard seam
+# Round 13: the resharding planner calls :func:`maybe_kill_reshard`
+# between every plan step. With PYLOPS_MPI_TPU_FAULT_KILL_RESHARD=<N>
+# set, the process SIGKILLs itself when the process-global step counter
+# reaches N (1-based) — a worker dying mid-reshard, the scenario the
+# in-place recovery path must survive by falling back to the
+# checkpoint. Unset (the default) the seam is a counter bump only.
+_RESHARD_STEPS = {"count": 0}
+KILL_RESHARD_ENV = "PYLOPS_MPI_TPU_FAULT_KILL_RESHARD"
+
+
+def reset_reshard_steps() -> None:
+    with _LOCK:
+        _RESHARD_STEPS["count"] = 0
+
+
+def reshard_steps() -> int:
+    """Planner steps executed in this process since the last reset."""
+    with _LOCK:
+        return _RESHARD_STEPS["count"]
+
+
+def maybe_kill_reshard() -> None:
+    """Advance the reshard step counter; SIGKILL this process when it
+    reaches ``PYLOPS_MPI_TPU_FAULT_KILL_RESHARD`` (1-based). SIGKILL —
+    not an exception — because the fault being rehearsed is a dead
+    worker, and nothing (atexit, finally blocks, checkpoint flushes)
+    must get a chance to tidy up."""
+    with _LOCK:
+        _RESHARD_STEPS["count"] += 1
+        count = _RESHARD_STEPS["count"]
+    import os
+    raw = os.environ.get(KILL_RESHARD_ENV, "").strip()
+    if not raw:
+        return
+    if count >= int(raw):
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 def arm(kind: str, iteration: int, once: bool = True) -> None:

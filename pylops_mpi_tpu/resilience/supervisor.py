@@ -41,6 +41,7 @@ the tail of each log is collected into the result for post-mortems.
 from __future__ import annotations
 
 import json
+import math
 import os
 import signal
 import socket
@@ -80,6 +81,7 @@ class WorkerHandle:
     log_path: str
     launched_at: float        # monotonic; bring-up grace reference
     metrics_path: str = ""    # worker's assigned snapshot file
+    reconfig_path: str = ""   # in-place reassignment file (inplace=True)
 
     def alive(self) -> bool:
         return self.proc.poll() is None
@@ -265,7 +267,9 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
                                           None]] = None,
                on_relaunch: Optional[Callable[[int, Failure],
                                               None]] = None,
-               python: Optional[str] = None) -> JobResult:
+               python: Optional[str] = None,
+               inplace: bool = False,
+               quorum: float = 0.5) -> JobResult:
     """Launch ``num_workers`` supervised worker processes and babysit
     them to completion, relaunching on a shrunk world after failures.
 
@@ -309,7 +313,21 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
     Worker env: inherits ``os.environ``, overlaid with ``env``, overlaid
     with the elastic contract (contract wins — a stale
     ``PYLOPS_MPI_TPU_PROCESS_ID`` from an outer supervised run must not
-    leak into workers)."""
+    leak into workers).
+
+    In-place recovery (``inplace=True``): each worker additionally gets
+    a ``PYLOPS_MPI_TPU_RECONFIG_FILE`` assignment, and when a failure
+    leaves EXACTLY ONE live survivor meeting the ``quorum`` fraction of
+    the attempt's world (and relaunch budget remains), the supervisor
+    kills only the failed worker and writes the survivor a reconfig
+    naming the shrunk world — the survivor re-forms its mesh and
+    replans the live solver carry over collectives, with no checkpoint
+    write/read on the recovery path. Any other shape (multiple
+    survivors — a multi-process mesh cannot be re-formed without the
+    hanging ``jax.distributed`` teardown barrier — below-quorum, spent
+    budget, or a job timeout) takes the classic kill-all +
+    checkpoint-relaunch ladder. Decision table:
+    ``docs/robustness.md#in-place-recovery``."""
     if num_workers < 1:
         raise ValueError(f"num_workers must be >= 1, got {num_workers}")
     argv = [str(a) for a in argv]
@@ -328,7 +346,9 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
     for attempt in range(max_relaunches + 1):
         world = len(slots)
         port = free_port()
-        result.attempts = attempt + 1
+        # monotonic: in-place reconfigs also count an attempt, so the
+        # loop index alone cannot seed the total
+        result.attempts += 1
         result.world_size = world
         _trace.event("supervisor.launch", cat="resilience",
                      attempt=attempt, world=world, port=port,
@@ -341,6 +361,9 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
                                f"worker{slot}.attempt{attempt}.log")
             met = os.path.join(
                 logdir, f"worker{slot}.attempt{attempt}.metrics.json")
+            rcf = os.path.join(
+                logdir, f"worker{slot}.attempt{attempt}.reconfig.json") \
+                if inplace else ""
             wenv = dict(os.environ)
             if env:
                 wenv.update(env)
@@ -355,6 +378,12 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
                 # registry only starts its writer under METRICS=on
                 "PYLOPS_MPI_TPU_METRICS_FILE": met,
             })
+            if inplace:
+                wenv["PYLOPS_MPI_TPU_RECONFIG_FILE"] = rcf
+            else:
+                # a stale assignment from an outer supervised run must
+                # not arm in-place polling in this job's workers
+                wenv.pop("PYLOPS_MPI_TPU_RECONFIG_FILE", None)
             # relaunched peers must not re-dial the coordinator in
             # lockstep; setdefault so an explicit caller value wins
             wenv.setdefault("PYLOPS_MPI_TPU_RETRY_JITTER", "0.25")
@@ -372,7 +401,8 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
             workers.append(WorkerHandle(rank=rank, slot=slot, proc=proc,
                                         heartbeat_path=hb, log_path=log,
                                         launched_at=time.monotonic(),
-                                        metrics_path=met))
+                                        metrics_path=met,
+                                        reconfig_path=rcf))
 
         failure: Optional[Failure] = None
         while True:
@@ -407,6 +437,47 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
                                       **cls)
                     break
             if failure is not None:
+                # ---- in-place path: patch the live survivor instead
+                # of killing the attempt. Gates (the robustness.md
+                # decision table): armed, not a job timeout, relaunch
+                # budget left, quorum met, and EXACTLY one survivor —
+                # a multi-process mesh cannot be re-formed in place
+                # (the jax.distributed teardown barrier hangs while a
+                # peer is dead), so 2+ survivors fall through to the
+                # checkpoint-relaunch ladder.
+                survivors = [w for w in workers
+                             if w.slot != failure.slot and w.alive()]
+                need = max(1, math.ceil(quorum * world))
+                if (inplace and attempt < max_relaunches
+                        and len(survivors) == 1
+                        and len(survivors) >= need):
+                    result.failures.append(failure)
+                    _trace.event("supervisor.failure", cat="resilience",
+                                 **failure.as_dict())
+                    _kill_all([w for w in workers
+                               if w.slot == failure.slot])
+                    slots = [s for s in slots if s != failure.slot]
+                    for new_rank, w in enumerate(survivors):
+                        doc = {"attempt": attempt + 1,
+                               "num_processes": len(survivors),
+                               "process_id": new_rank,
+                               "coordinator": None,
+                               "lost_slot": failure.slot}
+                        tmp = w.reconfig_path + f".tmp{os.getpid()}"
+                        with open(tmp, "w") as f:
+                            json.dump(doc, f)
+                        os.replace(tmp, w.reconfig_path)
+                    result.attempts += 1
+                    result.world_size = len(survivors)
+                    world = len(survivors)
+                    _metrics.inc("supervisor.inplace_reconfigs")
+                    _trace.event("supervisor.inplace_reconfig",
+                                 cat="resilience", attempt=attempt + 1,
+                                 world=world, lost_slot=failure.slot,
+                                 slots=list(slots))
+                    workers = survivors
+                    failure = None
+                    continue
                 break
             if all(w.proc.poll() == 0 for w in workers):
                 result.ok = True

@@ -197,12 +197,17 @@ def cg_segmented(Op, y: Vector, x0: Optional[Vector] = None,
                  checkpoint_path: Optional[str] = None,
                  resume: bool = True, backend: Optional[str] = None,
                  guards: Optional[bool] = None,
-                 on_epoch: Optional[Callable] = None) -> SegmentedResult:
+                 on_epoch: Optional[Callable] = None,
+                 resume_state: Optional[dict] = None) -> SegmentedResult:
     """Segmented fused CG: epochs of ``epoch`` fused iterations,
     checkpointed to ``checkpoint_path`` after every epoch (when given)
-    and auto-resumed from it (``resume=True``) after a kill."""
+    and auto-resumed from it (``resume=True``) after a kill.
+    ``resume_state`` resumes from an in-memory carry instead — the
+    in-place elastic path hands the replanted bank here so recovery
+    never touches checkpoint I/O."""
     return _segmented(Op, y, x0, "cg", niter, 0.0, tol, epoch,
-                      checkpoint_path, resume, backend, guards, on_epoch)
+                      checkpoint_path, resume, backend, guards, on_epoch,
+                      resume_state)
 
 
 def cgls_segmented(Op, y: Vector, x0: Optional[Vector] = None,
@@ -211,15 +216,20 @@ def cgls_segmented(Op, y: Vector, x0: Optional[Vector] = None,
                    checkpoint_path: Optional[str] = None,
                    resume: bool = True, backend: Optional[str] = None,
                    guards: Optional[bool] = None,
-                   on_epoch: Optional[Callable] = None) -> SegmentedResult:
+                   on_epoch: Optional[Callable] = None,
+                   resume_state: Optional[dict] = None) -> SegmentedResult:
     """Segmented fused CGLS (classic two-sweep schedule); see
     :func:`cg_segmented`. A killed process re-invoking with the same
     ``checkpoint_path`` (and the same ``niter``/``damp``/``tol``)
     resumes from the last banked epoch and reproduces the
     uninterrupted trajectory bit-identically when ``epoch`` divides
-    the schedule the same way."""
+    the schedule the same way. ``resume_state`` (an in-memory carry,
+    e.g. :func:`~pylops_mpi_tpu.resilience.elastic.restore_carry`'s
+    output) takes precedence over the checkpoint and keeps the
+    recovery path free of checkpoint reads."""
     return _segmented(Op, y, x0, "cgls", niter, damp, tol, epoch,
-                      checkpoint_path, resume, backend, guards, on_epoch)
+                      checkpoint_path, resume, backend, guards, on_epoch,
+                      resume_state)
 
 
 _CG_FIELDS = ("x", "r", "c", "kold", "iiter", "cost", "status",
@@ -228,9 +238,28 @@ _CGLS_FIELDS = ("x", "s", "c", "q", "kold", "iiter", "cost", "cost1",
                 "status", "bestk", "stall")
 
 
+def _check_resume_state(state, expect):
+    """Validate an in-memory resume carry against the requested plan —
+    the same contract :func:`_load_carry` enforces for checkpoints."""
+    for key, want in expect.items():
+        got = state.get(key)
+        if isinstance(want, float):
+            ok = got is not None and float(got) == float(want)
+        else:
+            ok = got == want
+        if not ok:
+            raise ValueError(
+                f"resume_state was banked with {key}={got!r}, resume "
+                f"requested {key}={want!r}; resume must replay the "
+                "same plan")
+    return dict(state)
+
+
 def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
-               checkpoint_path, resume, backend, guards, on_epoch):
+               checkpoint_path, resume, backend, guards, on_epoch,
+               resume_state=None):
     from ..resilience import status as _rstatus
+    from ..resilience import elastic as _elastic
     from ..resilience.elastic import maybe_start_heartbeat
     from ..utils import checkpoint as _ckpt
     # under a supervisor (heartbeat file assigned in the env) the long
@@ -248,9 +277,15 @@ def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
     meta = {"niter": niter, "tol": float(tol), "guards": guards_on}
     if is_cgls:
         meta["damp"] = float(damp)
-    state = (_load_carry(checkpoint_path, solver, mesh, meta)
-             if resume else None)
+    if resume_state is not None:
+        state = _check_resume_state(resume_state, meta)
+    else:
+        state = (_load_carry(checkpoint_path, solver, mesh, meta)
+                 if resume else None)
     resumed = state is not None
+    # in-place elastic recovery: armed only under a supervisor that
+    # assigned a reconfig file (or forced on); plain use stays inert
+    ip_armed = _elastic.inplace_armed()
 
     with _trace.span(f"solver.{solver}_segmented", cat="solver",
                      op=type(Op).__name__, shape=Op.shape, niter=niter,
@@ -284,6 +319,13 @@ def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
 
         epochs = 0
         while True:
+            if ip_armed:
+                rc = _elastic.pending_reconfig()
+                if rc is not None:
+                    # the supervisor shrank the world under us; unwind
+                    # to the caller, who re-forms the mesh and resumes
+                    # from the banked carry (elastic_worker.py)
+                    raise _elastic.ElasticReconfig(rc)
             iiter = int(state["iiter"])
             code = int(state["status"])
             kmax = float(jnp.max(jnp.asarray(state["kold"])))
@@ -299,10 +341,16 @@ def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
             state = dict(zip(fields, out))
             state["floors"] = args[len(fields)]
             epochs += 1
-            if checkpoint_path:
+            if ip_armed or checkpoint_path:
                 carry = {**meta, "epoch": E, "schema": _FUSED_SCHEMA}
                 carry.update({f: state[f] for f in fields})
                 carry["floors"] = state["floors"]
+            if ip_armed:
+                # bank BEFORE the checkpoint write: any epoch the
+                # supervisor can observe as saved is also banked, so
+                # an in-place recovery never resumes behind the disk
+                _elastic.bank_carry(solver, carry)
+            if checkpoint_path:
                 _ckpt.save_fused_carry(checkpoint_path, solver, carry,
                                        backend=backend)
                 _trace.event("solver.checkpoint", cat="resilience",

@@ -287,20 +287,21 @@ def get_plan(op: str, *, shape, dtype=None, mesh=None,
     return plan
 
 
-def chunk_hint(where: str, width: int, n_shards: int) -> Optional[int]:
-    """Cached chunk-count plan for one pencil transpose —
+def chunk_hint(where: str, width: int, n_shards: int, *,
+               op: str = "pencil_transpose") -> Optional[int]:
+    """Cached chunk-count plan for one streamed collective —
     ``parallel.collectives.resolve_chunks`` consults this for
     default-sourced chunk counts (explicit ``comm_chunks=`` kwargs
-    never reach here). Cache-only by design: there is no analytic
+    never reach here), and the round-13 resharding planner with
+    ``op="reshard"``. Cache-only by design: there is no analytic
     reason to move off the env default without a measurement."""
     if tune_mode() == "off" or getattr(_tls, "active", False):
         return None
-    key = plan_key("pencil_transpose", (int(width),), None,
-                   int(n_shards), None)
+    key = plan_key(op, (int(width),), None, int(n_shards), None)
     entry = _cache.lookup(key)
     if entry is None:
         return None
-    sp = _space.space_for("pencil_transpose")
+    sp = _space.space_for(op)
     params = entry.get("params")
     if not (isinstance(params, dict) and sp is not None
             and sp.validate(params)):
@@ -311,11 +312,12 @@ def chunk_hint(where: str, width: int, n_shards: int) -> Optional[int]:
 
 def record_chunk_plan(width: int, n_shards: int, chunks: int,
                       trials: Optional[List[Dict]] = None,
-                      path: Optional[str] = None) -> str:
-    """Bank a measured chunk count for one transpose width (used by
-    the offline CLI after an FFT-family sweep). Returns the key."""
-    key = plan_key("pencil_transpose", (int(width),), None,
-                   int(n_shards), None)
+                      path: Optional[str] = None, *,
+                      op: str = "pencil_transpose") -> str:
+    """Bank a measured chunk count for one transpose/reshard width
+    (used by the offline CLI after an FFT-family sweep). Returns the
+    key."""
+    key = plan_key(op, (int(width),), None, int(n_shards), None)
     _cache.store(key, {"params": {"comm_chunks": int(chunks)},
                        "provenance": "tuned",
                        "trials": list(trials or [])}, path=path)

@@ -477,3 +477,11 @@ register_space(TuningSpace(
     cost=None,
     note="standalone chunk-count plans consumed by "
          "collectives.resolve_chunks for default-chunked transposes"))
+
+register_space(TuningSpace(
+    op="reshard",
+    axes=(Axis("comm_chunks", (1, 2, 4, 8)),),
+    cost=None,
+    note="chunk counts for the bounded-memory resharding planner "
+         "(parallel/reshard.py); the budget sets the floor, a banked "
+         "plan can only stream finer"))

@@ -451,6 +451,10 @@ def _load_pytree_impl(path: str, mesh=None,
                       backend: Optional[str] = None) -> Dict[str, Any]:
     backend = backend or os.environ.get("PYLOPS_MPI_TPU_CKPT_BACKEND",
                                         "native")
+    # every checkpoint read funnels through here; the in-place elastic
+    # acceptance test pins ZERO of these events on its recovery path
+    _trace.event("checkpoint.load", cat="checkpoint", path=path,
+                 backend=backend)
     if backend not in ("native", "orbax"):
         raise ValueError(f"unknown checkpoint backend {backend!r}")
     if backend == "orbax" or os.path.isdir(path):

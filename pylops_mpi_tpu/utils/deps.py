@@ -105,6 +105,11 @@ KNOBS = [
     ("PYLOPS_MPI_TPU_COMM_CHUNKS", "int>=1", "4",
      "utils/deps.py, ops/fft.py",
      "default chunk count for streamed pencil transposes"),
+    ("PYLOPS_MPI_TPU_RESHARD_BUDGET", "bytes (k/m/g suffixes)",
+     "unset (unbounded)", "parallel/reshard.py",
+     "peak per-device scratch ceiling of the resharding planner; a "
+     "move that cannot fit refuses with the minimum budget that "
+     "would succeed"),
     ("PYLOPS_MPI_TPU_HIERARCHICAL", "auto|on|off", "auto",
      "utils/deps.py (parallel/topology.py, "
      "ops/matrixmult|fft|stack|halo|derivatives)",
@@ -229,6 +234,27 @@ KNOBS = [
     ("PYLOPS_MPI_TPU_ATTEMPT", "int>=0", "set by supervisor",
      "resilience/elastic.py, resilience/supervisor.py",
      "0-based relaunch counter of the supervised job"),
+    ("PYLOPS_MPI_TPU_INPLACE", "auto|on|off", "auto",
+     "resilience/elastic.py (solvers/segmented.py)",
+     "in-place (no-checkpoint) elastic recovery: survivors bank the "
+     "solver carry each epoch and replan it onto the shrunk mesh on a "
+     "reconfig; auto arms only when the supervisor assigned a "
+     "reconfig file"),
+    ("PYLOPS_MPI_TPU_QUORUM", "float in (0,1]", "0.5",
+     "resilience/elastic.py, resilience/supervisor.py",
+     "surviving fraction of the attempt's world required before the "
+     "in-place path engages; below it the checkpoint-relaunch ladder "
+     "runs"),
+    ("PYLOPS_MPI_TPU_RECONFIG_FILE", "path",
+     "unset (set by supervisor under inplace=True)",
+     "resilience/elastic.py (resilience/supervisor.py)",
+     "per-worker in-place reassignment file; its presence is the auto "
+     "trigger for carry banking and reconfig polling"),
+    ("PYLOPS_MPI_TPU_FAULT_KILL_RESHARD", "int>=1", "unset (off)",
+     "resilience/faults.py (parallel/reshard.py)",
+     "chaos seam: SIGKILL this process when the reshard-step counter "
+     "reaches N — rehearses a worker dying mid-reshard so the "
+     "checkpoint fallback path stays proven"),
     ("PYLOPS_MPI_TPU_METRICS", "off|on", "off",
      "diagnostics/metrics.py (solvers, collectives, resilience, "
      "tuning)",

@@ -1,27 +1,37 @@
-"""Worker for the supervised elastic chaos test (ISSUE 8 acceptance).
+"""Worker for the supervised elastic chaos tests (ISSUE 8 + 13).
 
 Launched by ``resilience.launch_job`` (see
-``tests/test_supervisor.py::test_chaos_kill_recover_resume``), reading
-its identity from the elastic env contract
-(``pylops_mpi_tpu.resilience.elastic.worker_config``):
+``tests/test_supervisor.py``), reading its identity from the elastic
+env contract (``pylops_mpi_tpu.resilience.elastic.worker_config``):
 
 - **world > 1** (the initial attempt): two processes with 4 virtual
   CPU devices each join over gloo, build the dcn(2)×ici(4) hybrid mesh
   and run a SEGMENTED f64 CGLS solve, checkpointing the fused carry
   every epoch through the orbax backend (the multi-host one). A small
   ``on_epoch`` sleep keeps the solve long enough for the supervisor to
-  SIGSTOP one worker mid-solve.
+  SIGSTOP/SIGKILL one worker mid-solve.
 - **world == 1** (the shrunk attempt after the supervisor reaped the
   wedged peer): the surviving slot reruns THE SAME code on its local
   4-device mesh; ``resume=True`` picks up the epoch checkpoint, whose
   8-shard carry is elastically resharded onto the 4-device mesh, and
-  the solve runs to completion. The final iterate is written to
-  ``$PYLOPS_ELASTIC_OUT`` for the test to compare against the
-  uninterrupted trajectory.
+  the solve runs to completion.
 
-Same seed → identical data in every process and attempt, so the
-resumed trajectory is the uninterrupted one (f64, within regrid
-reduction-order noise ≪ 1e-6).
+In-place recovery (round 13, ``launch_job(inplace=True)``): instead of
+being killed and relaunched, the survivor catches
+:class:`~pylops_mpi_tpu.resilience.elastic.ElasticReconfig` at the
+epoch boundary, re-forms its mesh over the local devices, replants the
+banked carry through the bounded-memory resharding planner, and
+resumes the SAME solve via ``resume_state`` — zero checkpoint reads on
+that path (the test pins the trace). Any refusal (planner budget,
+mask, multi-survivor mesh) falls back to the classic checkpoint
+resume. The survivor's trace is dumped explicitly and the process
+leaves via ``os._exit`` — the ``jax.distributed`` shutdown atexit
+barrier would hang forever against the dead peer.
+
+The final iterate lands in ``$PYLOPS_ELASTIC_OUT`` for the test to
+compare against the uninterrupted trajectory. Same seed → identical
+data in every process and attempt, so the resumed trajectory is the
+uninterrupted one (f64, within regrid reduction-order noise ≪ 1e-6).
 """
 
 import os
@@ -71,10 +81,20 @@ def build_problem(pmt, mesh):
     return Op, dy, x0, xt
 
 
+def _finish(res, cfg, world):
+    out = os.environ.get("PYLOPS_ELASTIC_OUT")
+    if out:
+        np.save(out, np.asarray(res.x.asarray()))
+    print(f"ELASTIC OK attempt={cfg.attempt} world={world} "
+          f"rank={cfg.process_id or 0} iiter={int(res.iiter)}",
+          flush=True)
+
+
 def main() -> None:
-    from pylops_mpi_tpu.resilience.elastic import elastic_initialize
-    cfg = elastic_initialize()  # heartbeat + (world>1) gloo bring-up
+    from pylops_mpi_tpu.resilience import elastic as E
+    cfg = E.elastic_initialize()  # heartbeat + (world>1) gloo bring-up
     import pylops_mpi_tpu as pmt
+    from pylops_mpi_tpu.diagnostics import trace
 
     world = cfg.num_processes or 1
     if world > 1:
@@ -87,21 +107,64 @@ def main() -> None:
 
     Op, dy, x0, xt = build_problem(pmt, mesh)
     ckpt = os.environ["PYLOPS_ELASTIC_CKPT"]
-    epoch_sleep = float(os.environ.get("PYLOPS_ELASTIC_EPOCH_SLEEP",
-                                       "0.25"))
+    sleep_box = {"s": float(os.environ.get("PYLOPS_ELASTIC_EPOCH_SLEEP",
+                                           "0.25"))}
+    mark = os.environ.get("PYLOPS_ELASTIC_EPOCH_MARK")
 
     def on_epoch(info):
-        # stretch the solve so a mid-epoch SIGSTOP lands reliably;
-        # the heartbeat thread keeps beating through the sleep
-        time.sleep(epoch_sleep)
+        # the marker tells the chaos test an epoch is banked+saved, so
+        # its kill lands INSIDE the sleep that follows — mid-solve,
+        # outside any collective (a gloo peer dying inside one wedges
+        # the survivor)
+        if mark:
+            with open(mark, "w") as f:
+                f.write(str(info["epoch"]))
+        time.sleep(sleep_box["s"])
 
-    res = pmt.cgls_segmented(Op, dy, x0=x0, niter=60, tol=0.0, epoch=5,
-                             checkpoint_path=ckpt, resume=True,
-                             backend="orbax", on_epoch=on_epoch)
+    solve = dict(niter=60, tol=0.0, epoch=5, checkpoint_path=ckpt,
+                 backend="orbax", on_epoch=on_epoch)
+    try:
+        res = pmt.cgls_segmented(Op, dy, x0=x0, resume=True, **solve)
+    except E.ElasticReconfig as rc:
+        # ---- survivor-side in-place recovery: shrink without dying
+        cfg = E.apply_reconfig(rc.config)
+        world = cfg.num_processes or 1
+        sleep_box["s"] = 0.0  # the kill window is behind us: finish fast
+        tf = os.environ.get("PYLOPS_MPI_TPU_TRACE_FILE")
+        try:
+            mesh = E.reform_mesh(cfg)  # world>1 raises -> relaunch
+            pmt.set_default_mesh(mesh)
+            Op, dy, x0, xt = build_problem(pmt, mesh)
+            state = E.restore_carry("cgls", mesh)
+            # the orbax checkpoint machinery is bound to the dead
+            # 2-process runtime (its barriers would run dead-peer
+            # collectives): post-recovery epochs checkpoint natively
+            # to a sibling path
+            solve.update(checkpoint_path=ckpt + ".inplace",
+                         backend="native")
+            res = pmt.cgls_segmented(Op, dy, x0=x0, resume=False,
+                                     resume_state=state, **solve)
+        except Exception as exc:  # planner refusal, lost bank, …
+            # NO same-process checkpoint fallback: any checkpoint read
+            # here would run collectives against the dead peer. Die
+            # loudly; the supervisor's relaunch ladder resumes from the
+            # checkpoint in a FRESH process.
+            print(f"ELASTIC INPLACE FALLBACK: {type(exc).__name__}: "
+                  f"{exc}", flush=True)
+            if tf:
+                trace.dump(tf)
+            sys.stdout.flush()
+            os._exit(5)
+        _finish(res, cfg, world)
+        if tf:
+            trace.dump(tf)
+        # the dead peer makes jax.distributed's atexit shutdown barrier
+        # hang (then abort); leave without running atexit
+        sys.stdout.flush()
+        os._exit(0)
     if world == 1:
-        out = os.environ.get("PYLOPS_ELASTIC_OUT")
-        if out:
-            np.save(out, np.asarray(res.x.asarray()))
+        _finish(res, cfg, world)
+        return
     print(f"ELASTIC OK attempt={cfg.attempt} world={world} "
           f"rank={cfg.process_id or 0} iiter={int(res.iiter)}",
           flush=True)

@@ -16,7 +16,11 @@ def _diag_op(vals):
     return MPIBlockDiag([Diagonal(b, dtype=np.float64) for b in blocks])
 
 
-@pytest.mark.parametrize("fused", [True, False])
+# the unfused (host-loop) twin re-times the same spectrum oracle
+# (~8 s); slow-marked for the tier-1 wall budget (ISSUE 13) — the
+# default CI matrix runs this file unfiltered
+@pytest.mark.parametrize("fused", [
+    True, pytest.param(False, marks=pytest.mark.slow)])
 def test_power_iteration_known_spectrum(fused):
     vals = np.arange(1.0, 33.0)  # lambda_max = 32
     Op = _diag_op(vals)

@@ -213,6 +213,169 @@ def test_segmented_resume_plan_mismatch_still_guards(tmp_path,
                            checkpoint_path=path, resume=True)
 
 
+# ------------------------------- in-place (no-checkpoint) recovery
+@pytest.fixture(autouse=True)
+def _clear_bank():
+    from pylops_mpi_tpu.resilience import elastic as E
+    E.clear_carry()
+    yield
+    E.clear_carry()
+
+
+@pytest.mark.parametrize("new_ndev", [4, 8])
+def test_inplace_cycle_matches_uninterrupted(tmp_path, monkeypatch,
+                                             ndev, new_ndev):
+    """ISSUE 13 tentpole, in-process: a segmented CGLS armed for
+    in-place recovery banks its carry each epoch; a reconfig assignment
+    landing mid-solve raises ``ElasticReconfig`` at the next epoch
+    boundary, and the solve resumed from the REPLANTED bank reproduces
+    the uninterrupted trajectory — bit-identically on the same device
+    count, within f64 reduction-order noise across the 8 -> 4 regrid —
+    with zero ``checkpoint.load`` events (trace-pinned: the recovery
+    path never touches checkpoint I/O)."""
+    import json
+
+    from pylops_mpi_tpu.diagnostics import trace
+    from pylops_mpi_tpu.resilience import elastic as E
+
+    def rngs():
+        return np.random.default_rng(7)
+
+    mesh8 = make_mesh(ndev)
+    set_default_mesh(mesh8)
+    Op, y, x0 = _problem(mesh8, rngs())
+    ref = pmt.cgls_segmented(Op, y, x0=x0, niter=24, tol=0.0, epoch=4)
+    xref = np.asarray(ref.x.asarray())
+
+    rcf = str(tmp_path / "rc.json")
+    monkeypatch.setenv("PYLOPS_MPI_TPU_INPLACE", "on")
+    monkeypatch.setenv("PYLOPS_MPI_TPU_RECONFIG_FILE", rcf)
+    monkeypatch.setenv("PYLOPS_MPI_TPU_ATTEMPT", "0")
+    # apply_reconfig rewrites these in-place; seed them through
+    # monkeypatch so the teardown scrubs the leak
+    monkeypatch.setenv("PYLOPS_MPI_TPU_NUM_PROCESSES", "1")
+    monkeypatch.setenv("PYLOPS_MPI_TPU_PROCESS_ID", "0")
+    monkeypatch.setenv("PYLOPS_MPI_TPU_TRACE", "spans")
+    trace.clear_events()
+
+    def reconfigure(info):
+        # the supervisor's reassignment lands after epoch 2's bank
+        if info["epoch"] == 2 and not os.path.exists(rcf):
+            with open(rcf, "w") as f:
+                json.dump({"attempt": 1, "num_processes": 1,
+                           "process_id": 0, "coordinator": None,
+                           "lost_slot": 1}, f)
+
+    with pytest.raises(E.ElasticReconfig) as ei:
+        pmt.cgls_segmented(Op, y, x0=x0, niter=24, tol=0.0, epoch=4,
+                           on_epoch=reconfigure)
+    cfg = E.apply_reconfig(ei.value.config)
+    assert (cfg.num_processes, cfg.attempt) == (1, 1)
+    assert E.pending_reconfig() is None  # the ATTEMPT bump consumed it
+
+    new_mesh = make_mesh(new_ndev)
+    set_default_mesh(new_mesh)
+    state = E.restore_carry("cgls", new_mesh)
+    assert int(state["iiter"]) == 8  # two banked epochs of 4
+    Op2, y2, x02 = _problem(new_mesh, rngs())
+    res = pmt.cgls_segmented(Op2, y2, x0=x02, niter=24, tol=0.0,
+                             epoch=4, resume=False, resume_state=state)
+    got = np.asarray(res.x.asarray())
+    assert int(res.iiter) == int(ref.iiter)
+    if new_ndev == ndev:  # same shard count: exactly the same programs
+        np.testing.assert_array_equal(got, xref)
+    else:  # regrid: reduction order differs, f64 noise only
+        np.testing.assert_allclose(got, xref, rtol=1e-9, atol=1e-12)
+
+    names = [e["name"] for e in trace.get_events()]
+    assert "resilience.carry_banked" in names
+    assert "resilience.inplace_recovery" in names
+    assert "checkpoint.load" not in names
+    trace.clear_events()
+
+
+def test_inplace_resume_state_plan_mismatch(monkeypatch, ndev):
+    """The in-memory resume carry enforces the same plan contract as a
+    checkpoint: a bank taken under one ``niter`` refuses another."""
+    from pylops_mpi_tpu.resilience import elastic as E
+    monkeypatch.setenv("PYLOPS_MPI_TPU_INPLACE", "on")
+    mesh = make_mesh(ndev)
+    set_default_mesh(mesh)
+    Op, y, x0 = _problem(mesh, np.random.default_rng(3))
+    pmt.cgls_segmented(Op, y, x0=x0, niter=8, tol=0.0, epoch=4)
+    state = E.restore_carry("cgls", mesh)
+    with pytest.raises(ValueError, match="resume must replay"):
+        pmt.cgls_segmented(Op, y, x0=x0, niter=12, tol=0.0, epoch=4,
+                           resume=False, resume_state=state)
+
+
+def test_bank_and_restore_field_kinds(rng, ndev):
+    """Vector fields replant with partition/axis/mask preserved; raw
+    scalars and plain arrays round-trip; an unbanked tag is KeyError."""
+    import jax.numpy as jnp
+
+    from pylops_mpi_tpu.resilience import elastic as E
+    mesh = make_mesh(ndev)
+    v = rng.standard_normal(45)  # ragged on 8 AND on 4
+    carry = {"x": DistributedArray.to_dist(v, mesh=mesh),
+             "b": DistributedArray.to_dist(rng.standard_normal(5),
+                                           mesh=mesh,
+                                           partition=Partition.BROADCAST),
+             "k": 3, "name": "cgls", "f": 2.5, "none": None,
+             "arr": jnp.arange(4.0)}
+    E.bank_carry("t", carry)
+    rec = E.banked_carry("t")
+    assert rec["fields"]["x"]["kind"] == "dist"
+    assert rec["fields"]["k"]["kind"] == "raw"
+
+    small = make_mesh(4)
+    state = E.restore_carry("t", small)
+    assert state["x"].mesh is small and len(state["x"].local_shapes) == 4
+    np.testing.assert_array_equal(np.asarray(state["x"].asarray()), v)
+    assert state["b"].partition is Partition.BROADCAST
+    assert (state["k"], state["name"], state["f"]) == (3, "cgls", 2.5)
+    assert state["none"] is None
+    np.testing.assert_array_equal(np.asarray(state["arr"]),
+                                  np.arange(4.0))
+
+    E.clear_carry("t")
+    with pytest.raises(KeyError, match="no banked carry"):
+        E.restore_carry("t", small)
+
+
+def test_bank_refuses_stacked(rng, ndev):
+    from pylops_mpi_tpu import StackedDistributedArray
+    from pylops_mpi_tpu.resilience import elastic as E
+    mesh = make_mesh(ndev)
+    st = StackedDistributedArray(
+        [DistributedArray.to_dist(rng.standard_normal(16), mesh=mesh)])
+    with pytest.raises(TypeError, match="stacked"):
+        E.bank_carry("t", {"x": st})
+
+
+def test_restore_refusals_masked_and_budget(rng, ndev):
+    """The planner's refusals surface through ``restore_carry`` so the
+    caller can fall back to the checkpoint: a topology-bound mask on a
+    changed world, and a budget below the planner's minimum (the error
+    names it)."""
+    from pylops_mpi_tpu.parallel.reshard import ReshardError
+    from pylops_mpi_tpu.resilience import elastic as E
+    mesh = make_mesh(ndev)
+    xm = DistributedArray.to_dist(rng.standard_normal(16), mesh=mesh,
+                                  mask=[0, 0, 1, 1, 0, 0, 1, 1])
+    E.bank_carry("m", {"x": xm})
+    with pytest.raises(ReshardError, match="mask"):
+        E.restore_carry("m", make_mesh(4))
+    # same world: the mask replants intact
+    state = E.restore_carry("m", make_mesh(ndev))
+    assert tuple(state["x"].mask) == (0, 0, 1, 1, 0, 0, 1, 1)
+
+    E.bank_carry("b", {"x": DistributedArray.to_dist(
+        rng.standard_normal(48), mesh=mesh)})
+    with pytest.raises(ReshardError, match="minimum budget"):
+        E.restore_carry("b", make_mesh(4), budget=1)
+
+
 # ------------------------------------------------- kill-mid-save
 def test_kill_mid_save_previous_checkpoint_survives(tmp_path, rng):
     """ISSUE 8 satellite: a writer killed mid-save leaves only a

@@ -208,6 +208,10 @@ def test_distributed_nonstatconv(rng):
                                rtol=1e-10)
 
 
+# ~7 s of compile; the 2-D grid + sandwich tests keep tier-1 halo-grid
+# coverage and the test-ragged / test-overlap CI legs run this file
+# unfiltered (tier-1 wall budget, ISSUE 13)
+@pytest.mark.slow
 def test_halo_3d_grid(rng):
     """3-D Cartesian process grid (2x2x2): forward pads every axis with
     neighbour slabs, corners relayed axis-by-axis; adjoint crops back to

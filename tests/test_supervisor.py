@@ -39,7 +39,10 @@ _ELASTIC_ENV = ("PYLOPS_MPI_TPU_COORDINATOR",
                 "PYLOPS_MPI_TPU_PROCESS_ID", "PYLOPS_MPI_TPU_ATTEMPT",
                 "PYLOPS_MPI_TPU_HEARTBEAT_FILE", "PYLOPS_MPI_TPU_HEARTBEAT",
                 "PYLOPS_MPI_TPU_WATCHDOG",
-                "PYLOPS_MPI_TPU_WATCHDOG_TIMEOUT")
+                "PYLOPS_MPI_TPU_WATCHDOG_TIMEOUT",
+                "PYLOPS_MPI_TPU_INPLACE", "PYLOPS_MPI_TPU_QUORUM",
+                "PYLOPS_MPI_TPU_RECONFIG_FILE",
+                "PYLOPS_MPI_TPU_FAULT_KILL_RESHARD")
 
 
 @pytest.fixture(autouse=True)
@@ -303,6 +306,132 @@ def test_launch_job_logs_kept(tmp_path):
     assert any(p.endswith(".log") for p in os.listdir(tmp_path))
 
 
+# ------------------------------------- in-place recovery (ISSUE 13)
+def test_inplace_mode_and_arming(monkeypatch):
+    assert elastic.inplace_mode() == "auto"
+    assert not elastic.inplace_armed()  # auto + no assignment
+    monkeypatch.setenv("PYLOPS_MPI_TPU_RECONFIG_FILE", "/tmp/rc.json")
+    assert elastic.inplace_armed()      # auto + supervisor assignment
+    monkeypatch.setenv("PYLOPS_MPI_TPU_INPLACE", "off")
+    assert not elastic.inplace_armed()  # explicit off beats assignment
+    monkeypatch.delenv("PYLOPS_MPI_TPU_RECONFIG_FILE")
+    monkeypatch.setenv("PYLOPS_MPI_TPU_INPLACE", "on")
+    assert elastic.inplace_armed()      # explicit on needs no file
+
+
+def test_unknown_inplace_mode_warns_once(monkeypatch):
+    monkeypatch.setenv("PYLOPS_MPI_TPU_INPLACE", "sideways")
+    monkeypatch.setattr(elastic, "_warned_ip", False)
+    with pytest.warns(UserWarning, match="PYLOPS_MPI_TPU_INPLACE"):
+        assert elastic.inplace_mode() == "auto"
+
+
+def test_quorum_fraction_parsing(monkeypatch):
+    assert elastic.quorum_fraction() == 0.5
+    monkeypatch.setenv("PYLOPS_MPI_TPU_QUORUM", "0.75")
+    assert elastic.quorum_fraction() == 0.75
+    monkeypatch.setenv("PYLOPS_MPI_TPU_QUORUM", "7")
+    assert elastic.quorum_fraction() == 1.0   # clamped into (0, 1]
+    monkeypatch.setenv("PYLOPS_MPI_TPU_QUORUM", "junk")
+    assert elastic.quorum_fraction() == 0.5   # malformed -> default
+
+
+def test_pending_reconfig_lifecycle(tmp_path, monkeypatch):
+    rcf = str(tmp_path / "rc.json")
+    monkeypatch.setenv("PYLOPS_MPI_TPU_RECONFIG_FILE", rcf)
+    assert elastic.pending_reconfig() is None      # no file yet
+    with open(rcf, "w") as f:
+        f.write("{not json")                       # torn write: skip
+    assert elastic.pending_reconfig() is None
+    with open(rcf, "w") as f:
+        json.dump({"attempt": 0}, f)               # not newer than ours
+    assert elastic.pending_reconfig() is None
+    doc = {"attempt": 1, "num_processes": 1, "process_id": 0,
+           "coordinator": None, "lost_slot": 1}
+    with open(rcf, "w") as f:
+        json.dump(doc, f)
+    rc = elastic.pending_reconfig()
+    assert rc == doc
+    cfg = elastic.apply_reconfig(rc)
+    assert (cfg.num_processes, cfg.process_id, cfg.attempt) == (1, 0, 1)
+    # applying bumped PYLOPS_MPI_TPU_ATTEMPT, which consumes the doc
+    assert elastic.pending_reconfig() is None
+
+
+def test_reform_mesh_refuses_multiprocess(monkeypatch):
+    monkeypatch.setenv("PYLOPS_MPI_TPU_NUM_PROCESSES", "2")
+    with pytest.raises(RuntimeError, match="checkpoint"):
+        elastic.reform_mesh(worker_config())
+
+
+def test_reform_mesh_single_process_local_devices(monkeypatch):
+    import jax
+    monkeypatch.setenv("PYLOPS_MPI_TPU_NUM_PROCESSES", "1")
+    mesh = elastic.reform_mesh(worker_config())
+    assert mesh.devices.size == len(jax.local_devices())
+
+
+def test_launch_job_inplace_single_survivor_reconfig(tmp_path):
+    """ISSUE 13: a 2-worker job loses one worker; with ``inplace=True``
+    the supervisor keeps the survivor ALIVE and hands it a reconfig
+    file naming the shrunk world instead of killing + relaunching."""
+    code = (
+        "import os, sys, time, json\n"
+        "rcf = os.environ['PYLOPS_MPI_TPU_RECONFIG_FILE']\n"
+        "if os.environ['PYLOPS_MPI_TPU_PROCESS_ID'] == '1':\n"
+        "    sys.exit(3)\n"
+        "for _ in range(1200):\n"
+        "    if os.path.exists(rcf):\n"
+        "        print('RECONFIG', json.dumps(json.load(open(rcf))))\n"
+        "        sys.exit(0)\n"
+        "    time.sleep(0.05)\n"
+        "sys.exit(9)\n")
+    r = _job([sys.executable, "-c", code], 2, inplace=True,
+             logdir=str(tmp_path))
+    assert r.ok, r.failures
+    assert r.attempts == 2 and r.world_size == 1
+    assert [f.kind for f in r.failures] == ["exit"]
+    doc = json.loads(r.outputs[0].split("RECONFIG ", 1)[1])
+    assert doc == {"attempt": 1, "num_processes": 1, "process_id": 0,
+                   "coordinator": None, "lost_slot": 1}
+
+
+def test_launch_job_inplace_multi_survivor_falls_back(tmp_path):
+    """Two live survivors cannot re-form a mesh in place (the
+    ``jax.distributed`` teardown barrier hangs against a dead peer),
+    so the supervisor takes the classic kill-all + shrink ladder and
+    never writes a reconfig."""
+    code = (
+        "import os, sys, time\n"
+        "if os.environ['PYLOPS_MPI_TPU_ATTEMPT'] == '0':\n"
+        "    if os.environ['PYLOPS_MPI_TPU_PROCESS_ID'] == '2':\n"
+        "        sys.exit(3)\n"
+        "    time.sleep(120)\n"
+        "sys.exit(0)\n")
+    r = _job([sys.executable, "-c", code], 3, inplace=True,
+             logdir=str(tmp_path))
+    assert r.ok and r.attempts == 2 and r.world_size == 2
+    assert not any(p.endswith(".reconfig.json")
+                   for p in os.listdir(tmp_path))
+
+
+def test_launch_job_inplace_below_quorum_falls_back(tmp_path):
+    """quorum=0.9 of a 2-world needs 2 survivors; 1 survivor is below
+    quorum, so in-place refuses and the relaunch ladder runs."""
+    code = (
+        "import os, sys, time\n"
+        "if os.environ['PYLOPS_MPI_TPU_ATTEMPT'] == '0':\n"
+        "    if os.environ['PYLOPS_MPI_TPU_PROCESS_ID'] == '1':\n"
+        "        sys.exit(3)\n"
+        "    time.sleep(120)\n"
+        "sys.exit(0)\n")
+    r = _job([sys.executable, "-c", code], 2, inplace=True, quorum=0.9,
+             logdir=str(tmp_path))
+    assert r.ok and r.attempts == 2 and r.world_size == 1
+    assert not any(p.endswith(".reconfig.json")
+                   for p in os.listdir(tmp_path))
+
+
 # -------------------------------------------------- off-mode identity
 def test_watchdog_off_mode_hlo_and_trace_identical(rng, monkeypatch):
     """Arming gates only host-side behavior: lowered HLO of a fused
@@ -380,6 +509,135 @@ def test_chaos_kill_recover_resume(tmp_path):
 
     # the resumed (shrunk, 4-device) final iterate vs the
     # uninterrupted reference computed in-process on 8 devices
+    ref = _uninterrupted_reference()
+    got = np.load(out)
+    rel = np.max(np.abs(got - ref)) / np.max(np.abs(ref))
+    assert rel < 1e-6, rel
+
+
+def _trace_names(path):
+    names = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                names.append(json.loads(line).get("name", ""))
+    return names
+
+
+@pytest.mark.slow
+def test_chaos_inplace_kill_recover(tmp_path):
+    """ISSUE 13 acceptance: 2-process segmented CGLS with
+    ``launch_job(inplace=True)``; one worker is SIGKILLed mid-solve
+    (inside the epoch-boundary sleep, after the carry was banked). The
+    supervisor classifies the death, keeps the survivor alive and
+    writes it a reconfig; the survivor re-forms its local mesh,
+    replants the banked carry through the bounded-memory resharding
+    planner and resumes — with ZERO checkpoint reads on the recovery
+    path (trace-pinned) and a final iterate matching the uninterrupted
+    reference."""
+    ckpt = str(tmp_path / "carry.orbax")
+    out = str(tmp_path / "final_x.npy")
+    mark = str(tmp_path / "epoch.mark")
+    tracef = str(tmp_path / "survivor.trace.jsonl")
+    env = {"PYLOPS_ELASTIC_CKPT": ckpt, "PYLOPS_ELASTIC_OUT": out,
+           "PYLOPS_ELASTIC_EPOCH_MARK": mark,
+           "PYLOPS_ELASTIC_EPOCH_SLEEP": "2.0",
+           "PYLOPS_MPI_TPU_TRACE": "spans",
+           "PYLOPS_MPI_TPU_TRACE_FILE": tracef,
+           "XLA_FLAGS": " ".join(
+               f for f in os.environ.get("XLA_FLAGS", "").split()
+               if "force_host_platform_device_count" not in f)}
+    killed = []
+
+    def on_poll(attempt, workers):
+        # kill worker slot 1 INSIDE the sleep that follows an epoch's
+        # bank+save: outside any gloo collective (a peer dying inside
+        # one wedges the survivor), after state worth recovering exists
+        if not killed and os.path.exists(mark):
+            for w in workers:
+                if w.slot == 1 and w.alive():
+                    w.proc.send_signal(signal.SIGKILL)
+                    killed.append(w.slot)
+
+    budget = stage_budget("multihost_chaos", rehearse=True)
+    r = launch_job([os.path.join(ROOT, "tests", "elastic_worker.py")],
+                   2, heartbeat_interval=0.4, stale_factor=2.0,
+                   on_poll=on_poll, job_timeout_s=budget, env=env,
+                   inplace=True)
+    assert r.ok, (r.failures, {k: v[-2000:] for k, v in r.outputs.items()})
+    assert r.attempts == 2 and r.world_size == 1
+    assert [f.kind for f in r.failures] == ["signal"]
+    assert r.failures[0].slot == 1
+    assert "ELASTIC OK" in r.outputs[0]
+    assert "INPLACE FALLBACK" not in r.outputs[0]
+
+    # the trace pin: the survivor recovered through the in-place
+    # collective path and never touched the checkpoint reader
+    names = _trace_names(tracef)
+    assert "resilience.carry_banked" in names
+    assert "resilience.mesh_reformed" in names
+    assert "resilience.inplace_recovery" in names
+    assert "collective.reshard.step" in names
+    assert "checkpoint.load" not in names
+
+    ref = _uninterrupted_reference()
+    got = np.load(out)
+    rel = np.max(np.abs(got - ref)) / np.max(np.abs(ref))
+    assert rel < 1e-6, rel
+
+
+@pytest.mark.slow
+def test_chaos_kill_mid_reshard_falls_back(tmp_path):
+    """ISSUE 13 satellite: the survivor is itself killed MID-RESHARD
+    (the ``faults.maybe_kill_reshard`` seam fires on the first planner
+    step of the in-place restore), and the job still completes through
+    the checkpoint-relaunch fallback with zero divergence. The
+    relaunched worker's trace HAS the checkpoint read the in-place
+    path avoids."""
+    ckpt = str(tmp_path / "carry.orbax")
+    out = str(tmp_path / "final_x.npy")
+    mark = str(tmp_path / "epoch.mark")
+    tracef = str(tmp_path / "worker.trace.jsonl")
+    env = {"PYLOPS_ELASTIC_CKPT": ckpt, "PYLOPS_ELASTIC_OUT": out,
+           "PYLOPS_ELASTIC_EPOCH_MARK": mark,
+           "PYLOPS_ELASTIC_EPOCH_SLEEP": "2.0",
+           "PYLOPS_MPI_TPU_TRACE": "spans",
+           "PYLOPS_MPI_TPU_TRACE_FILE": tracef,
+           # SIGKILL on the FIRST reshard step: mid in-place restore.
+           # The checkpoint restore path never touches the planner, so
+           # the relaunched worker survives the same env.
+           "PYLOPS_MPI_TPU_FAULT_KILL_RESHARD": "1",
+           "XLA_FLAGS": " ".join(
+               f for f in os.environ.get("XLA_FLAGS", "").split()
+               if "force_host_platform_device_count" not in f)}
+    killed = []
+
+    def on_poll(attempt, workers):
+        if not killed and os.path.exists(mark):
+            for w in workers:
+                if w.slot == 1 and w.alive():
+                    w.proc.send_signal(signal.SIGKILL)
+                    killed.append(w.slot)
+
+    budget = stage_budget("multihost_chaos", rehearse=True)
+    r = launch_job([os.path.join(ROOT, "tests", "elastic_worker.py")],
+                   2, heartbeat_interval=0.4, stale_factor=2.0,
+                   on_poll=on_poll, job_timeout_s=budget, env=env,
+                   inplace=True, shrink=False, max_relaunches=2)
+    assert r.ok, (r.failures, {k: v[-2000:] for k, v in r.outputs.items()})
+    # launch + in-place reconfig + checkpoint relaunch
+    assert r.attempts == 3 and r.world_size == 1
+    assert [f.kind for f in r.failures] == ["signal", "signal"]
+    assert [f.slot for f in r.failures] == [1, 0]
+    assert "ELASTIC OK" in r.outputs[0]
+
+    # the relaunched worker resumed from the checkpoint: its trace has
+    # the read, and no in-place recovery
+    names = _trace_names(tracef)
+    assert "checkpoint.load" in names
+    assert "resilience.inplace_recovery" not in names
+
     ref = _uninterrupted_reference()
     got = np.load(out)
     rel = np.max(np.abs(got - ref)) / np.max(np.abs(ref))
