@@ -44,6 +44,9 @@ PAGES = {
          ["Layout", "ReshardStep", "ReshardPlan", "ReshardError",
           "reshard_budget", "plan_reshard", "reshard", "place_replica",
           "reshard_raw"]),
+        ("Host-RAM spill tier", "pylops_mpi_tpu.parallel.spill",
+         ["HostArray", "to_host", "reshard_from_host", "run_spilled",
+          "chunk_hint_spill", "overlap_hint_spill", "record_spill_plan"]),
         ("Fabric topology", "pylops_mpi_tpu.parallel.topology",
          ["fabric_override", "axis_fabric", "mesh_fabrics", "is_hybrid",
           "hybrid_axes", "topology_key", "collective_fabric", "slice_map",

@@ -42,6 +42,7 @@ from .solvers.block import (block_cg, block_cgls, block_cg_segmented,
 from .solvers.eigs import power_iteration
 from .parallel.reshard import (Layout, ReshardError, plan_reshard,
                                reshard_budget)
+from .parallel.spill import HostArray
 from .resilience import resilient_solve
 from .utils.dottest import dottest
 from .plotting.plotting import plot_distributed_array, plot_local_arrays

@@ -150,8 +150,17 @@ def collective_bytes(name: str, nbytes: float,
     :mod:`pylops_mpi_tpu.parallel.topology`, the same bytes ALSO land
     in ``collective.{name}.bytes_ici`` / ``.bytes_dcn``. A split
     emission (one call per fabric share of a two-level collective) sums
-    back to the legacy counter by construction."""
+    back to the legacy counter by construction.
+
+    Round 14: ``fabric="h2d"``/``"d2h"`` account the host-staging
+    transfers of the spill tier (``parallel/spill.py``) into
+    ``collective.{name}.bytes_h2d`` / ``.bytes_d2h`` ONLY — host↔device
+    copies are not inter-device payload, so they never inflate the
+    legacy ``.bytes`` counter dashboards key on."""
     if metrics_mode() == "off":
+        return
+    if fabric in ("h2d", "d2h"):
+        inc(f"collective.{name}.bytes_{fabric}", nbytes)
         return
     inc(f"collective.{name}.bytes", nbytes)
     if fabric in ("ici", "dcn"):

@@ -700,6 +700,21 @@ class DistributedArray:
                                 axis=axis, local_shapes=local_shapes,
                                 budget=budget, chunks=chunks)
 
+    def to_host(self, *, budget=..., chunks: Optional[int] = None,
+                overlap: Optional[str] = None):
+        """Evacuate to host RAM as a
+        :class:`~pylops_mpi_tpu.parallel.spill.HostArray` (layout
+        metadata preserved), streaming chunk-at-a-time under the
+        budget — the explicit spill of the round-14 host-staging tier.
+        ``HostArray.to_device()`` is the inverse. See
+        :func:`pylops_mpi_tpu.parallel.spill.to_host`."""
+        from .parallel import reshard as _reshard
+        from .parallel import spill as _spill
+        if budget is ...:
+            budget = _reshard._UNSET
+        return _spill.to_host(self, budget=budget, chunks=chunks,
+                              overlap=overlap)
+
     # -------------------------------------------------------- ghost cells
     def _ghost_widths(self, cells_front, cells_back):
         """Validated (front, back) widths with the reference's error

@@ -6,3 +6,5 @@ from . import topology
 from . import reshard
 from .reshard import (Layout, ReshardError, ReshardPlan, ReshardStep,
                       plan_reshard, place_replica, reshard_budget)
+from . import spill
+from .spill import HostArray

@@ -111,7 +111,9 @@ def _collective_seq(name: str) -> int:
 def _count_collective(name: str, nbytes: Optional[int] = None,
                       fabric: Optional[str] = None,
                       nbytes_ici: Optional[int] = None,
-                      nbytes_dcn: Optional[int] = None) -> int:
+                      nbytes_dcn: Optional[int] = None,
+                      nbytes_h2d: Optional[int] = None,
+                      nbytes_d2h: Optional[int] = None) -> int:
     """Metrics + sequencing for one collective dispatch: bumps the
     per-op call (and, when an estimate exists, byte) counters in the
     metrics registry and returns this call's sequence number for the
@@ -119,7 +121,10 @@ def _count_collective(name: str, nbytes: Optional[int] = None,
     ``.bytes_ici``/``.bytes_dcn`` (``None`` — a flat mesh — keeps only
     the legacy ``.bytes`` counter); a two-level collective passes its
     per-phase shares via ``nbytes_ici``/``nbytes_dcn`` instead, which
-    sum into the legacy counter."""
+    sum into the legacy counter. Round 14: a host-staged (spilled)
+    move passes its transfer bytes via ``nbytes_h2d``/``nbytes_d2h``;
+    those land in ``.bytes_h2d``/``.bytes_d2h`` only — host↔device
+    copies are not inter-device payload."""
     _metrics.inc(f"collective.{name}.calls")
     if nbytes is not None:
         _metrics.collective_bytes(name, int(nbytes), fabric)
@@ -127,6 +132,10 @@ def _count_collective(name: str, nbytes: Optional[int] = None,
         _metrics.collective_bytes(name, int(nbytes_ici), "ici")
     if nbytes_dcn:
         _metrics.collective_bytes(name, int(nbytes_dcn), "dcn")
+    if nbytes_h2d:
+        _metrics.collective_bytes(name, int(nbytes_h2d), "h2d")
+    if nbytes_d2h:
+        _metrics.collective_bytes(name, int(nbytes_d2h), "d2h")
     return _collective_seq(name)
 
 

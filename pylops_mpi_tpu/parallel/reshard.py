@@ -56,7 +56,7 @@ from jax.sharding import Mesh
 
 from ..diagnostics import trace as _trace
 from .mesh import replicated_sharding
-from .partition import Partition, shard_offsets, unpad_index_map
+from .partition import Partition, local_split, shard_offsets, unpad_index_map
 from . import topology as _topo
 from .collectives import _count_collective
 
@@ -151,8 +151,10 @@ class Layout:
 class ReshardStep:
     """One planner step: ``kind`` is the collective family
     (``dynamic_slice`` carve/place steps move no bytes between
-    devices), ``nbytes``/``nbytes_ici``/``nbytes_dcn`` the exchanged
-    payload, ``scratch_bytes`` the live temporary the step holds."""
+    devices; ``host_stage`` steps of a spilled plan move bytes over
+    PCIe instead — ``nbytes_h2d``/``nbytes_d2h``, round 14),
+    ``nbytes``/``nbytes_ici``/``nbytes_dcn`` the exchanged payload,
+    ``scratch_bytes`` the live device temporary the step holds."""
     kind: str
     chunk: int
     lo: int
@@ -161,11 +163,20 @@ class ReshardStep:
     nbytes_ici: Optional[int] = None
     nbytes_dcn: Optional[int] = None
     scratch_bytes: int = 0
+    nbytes_h2d: int = 0
+    nbytes_d2h: int = 0
 
 
 @dataclass(frozen=True)
 class ReshardPlan:
-    """Host-side decomposition of one Partition→Partition move."""
+    """Host-side decomposition of one Partition→Partition move.
+
+    A **spilled** plan (round 14) stages every chunk through host RAM:
+    its steps are all ``host_stage``, its cross-device payload is zero
+    (the bytes move over PCIe, ``nbytes_h2d``/``nbytes_d2h``), and
+    ``host_dst`` marks a destination that stays in host RAM because it
+    would not fit the device budget (``dst_device_bytes`` is the
+    per-device footprint the destination would need)."""
     global_shape: Tuple[int, ...]
     itemsize: int
     src: Layout
@@ -180,6 +191,19 @@ class ReshardPlan:
     peak_scratch: int
     min_budget: int
     budget: Optional[int]
+    spilled: bool = False
+    host_dst: bool = False
+    nbytes_h2d: int = 0
+    nbytes_d2h: int = 0
+    dst_device_bytes: int = 0
+
+    def cost_model(self) -> int:
+        """Modeled peak *device* scratch in bytes: the largest live
+        step temporary. For a spilled plan this is one staging chunk —
+        the double-buffered executor's prefetch lives in host RAM, and
+        the overlap transient (at most two chunks in flight) is the
+        documented approximation."""
+        return max((s.scratch_bytes for s in self.steps), default=0)
 
 
 def _ceil_sizes(dim: int, n: int) -> Tuple[int, ...]:
@@ -224,17 +248,40 @@ def _pair_bytes(total: int, src: Layout, dst: Layout,
 def plan_reshard(global_shape: Sequence[int], itemsize: int,
                  src: Layout, dst: Layout, *,
                  budget=_UNSET, chunks: Optional[int] = None,
-                 slice_ids: Optional[Sequence[int]] = None) -> ReshardPlan:
+                 slice_ids: Optional[Sequence[int]] = None,
+                 spill: Optional[str] = None, src_host: bool = False,
+                 dst_host: Optional[bool] = None,
+                 topo_key: Optional[str] = None) -> ReshardPlan:
     """Plan one move. ``budget`` defaults to :func:`reshard_budget`
     (``None`` = unbounded); ``chunks`` forces at least that many
     chunks; ``slice_ids`` (per linearized rank, from
     :func:`~pylops_mpi_tpu.parallel.topology.slice_map`) drives the
     ici/dcn byte split. Raises :class:`ReshardError` when the budget
-    cannot fit one row of scratch."""
+    cannot fit one row of scratch.
+
+    Round 14: ``spill`` (default: ``PYLOPS_MPI_TPU_SPILL``) routes an
+    over-budget move through host RAM instead of refusing — under
+    ``"auto"`` ONLY a move the device planner would refuse spills, so
+    every succeeding plan stays bit-identical; ``"on"`` forces a
+    host-staged plan; ``"off"`` keeps the round-13 refusal. A spilled
+    plan needs only ONE live staging buffer, so its refusal floor is
+    one chunk row (``min_budget = row_bytes``). ``src_host`` marks a
+    host-resident source (no D2H half), ``dst_host`` pins the
+    destination to host RAM (``None`` = automatic: host when the
+    spilled destination's per-device footprint exceeds the budget),
+    and ``topo_key`` (from
+    :func:`~pylops_mpi_tpu.parallel.topology.topology_key`) is named
+    in refusal messages so hybrid-mesh failures are attributable."""
     global_shape = tuple(int(s) for s in global_shape)
     itemsize = int(itemsize)
     if budget is _UNSET:
         budget = reshard_budget()
+    if spill is None:
+        from ..utils.deps import spill_mode
+        spill = spill_mode()
+    if spill not in ("auto", "on", "off"):
+        raise ValueError(f"spill={spill!r}: expected one of "
+                         "['auto', 'on', 'off']")
     total = int(np.prod(global_shape, dtype=np.int64)) * itemsize
 
     if dst.is_scatter:
@@ -276,19 +323,32 @@ def plan_reshard(global_shape: Sequence[int], itemsize: int,
     row_bytes = max(1, total // rows)
     factor = 1 if comm == 0 else 2   # carved piece (+ its exchanged copy)
     min_budget = factor * row_bytes
+    topo_note = f" (topology {topo_key})" if topo_key else ""
+    spilled = spill == "on"
     c_budget = 1
-    if budget is not None:
+    if budget is not None and not spilled:
         w_max = int(budget) // (factor * row_bytes)
         if w_max < 1:
-            raise ReshardError(
-                f"reshard: budget {int(budget)} B cannot fit one "
-                f"{row_bytes}-byte row of axis {move_axis} "
-                f"({'x'.join(map(str, global_shape))}, {kind} move needs "
-                f"{factor} live buffers); the minimum budget that would "
-                f"succeed is {min_budget} B — raise "
-                f"{RESHARD_BUDGET_ENV} to at least {min_budget}",
-                min_budget)
-        c_budget = -(-rows // w_max)
+            if spill == "auto":
+                # the spill tier's reason to exist: a move the device
+                # planner must refuse runs host-staged instead
+                spilled = True
+            else:
+                raise ReshardError(
+                    f"reshard: budget {int(budget)} B cannot fit one "
+                    f"{row_bytes}-byte row of axis {move_axis} "
+                    f"({'x'.join(map(str, global_shape))}, {kind} move needs "
+                    f"{factor} live buffers); the minimum budget that would "
+                    f"succeed is {min_budget} B — raise "
+                    f"{RESHARD_BUDGET_ENV} to at least {min_budget}"
+                    f"{topo_note}",
+                    min_budget)
+        else:
+            c_budget = -(-rows // w_max)
+    if spilled:
+        return _plan_spilled(global_shape, itemsize, src, dst, move_axis,
+                             kind, rows, row_bytes, budget, chunks,
+                             src_host, dst_host, topo_note)
 
     hint = _chunk_hint(rows, max(src.n_shards, dst.n_shards))
     n_chunks = min(rows, max(c_budget, int(chunks or 1), int(hint or 1)))
@@ -328,6 +388,76 @@ def plan_reshard(global_shape: Sequence[int], itemsize: int,
     return ReshardPlan(global_shape, itemsize, src, dst, move_axis, kind,
                        n_chunks, tuple(steps), comm, nb_ici, nb_dcn,
                        peak, min_budget, budget)
+
+
+def _plan_spilled(global_shape, itemsize, src: Layout, dst: Layout,
+                  move_axis: int, kind: str, rows: int, row_bytes: int,
+                  budget, chunks, src_host: bool,
+                  dst_host: Optional[bool], topo_note: str) -> ReshardPlan:
+    """Build an all-``host_stage`` plan: every chunk is staged through
+    host RAM, so only ONE device buffer is ever live and the refusal
+    floor drops to one chunk row. The bytes move over PCIe
+    (``nbytes_h2d``/``nbytes_d2h`` per step); the logical collective
+    family ``kind`` is kept for provenance."""
+    if budget is not None and int(budget) < row_bytes:
+        raise ReshardError(
+            f"reshard: budget {int(budget)} B cannot fit one "
+            f"{row_bytes}-byte row of axis {move_axis} "
+            f"({'x'.join(map(str, global_shape))}, host-staged {kind} "
+            f"move needs 1 live staging buffer); the minimum budget "
+            f"that would succeed is {row_bytes} B — raise "
+            f"{RESHARD_BUDGET_ENV} to at least {row_bytes}{topo_note}",
+            row_bytes)
+    w_max = rows if budget is None else max(1, int(budget) // row_bytes)
+    c_budget = -(-rows // w_max)
+    hint = _chunk_hint_spilled(rows, max(src.n_shards, dst.n_shards))
+    n_chunks = min(rows, max(c_budget, int(chunks or 1), int(hint or 1)))
+    width = -(-rows // n_chunks)
+    n_chunks = -(-rows // width)    # drop empty tail chunks
+    if dst.is_scatter and dst.sizes:
+        dst_rows = max(dst.sizes)
+    else:
+        dst_rows = rows             # replicated: every device holds all
+    dst_device_bytes = dst_rows * row_bytes
+    if dst_host is None:
+        host_dst = budget is not None and dst_device_bytes > int(budget)
+    else:
+        host_dst = bool(dst_host)
+    steps = []
+    peak = h2d = d2h = 0
+    for c in range(n_chunks):
+        lo = c * width
+        hi = min(rows, lo + width)
+        cb = (hi - lo) * row_bytes
+        s_d2h = 0 if src_host else cb
+        s_h2d = 0 if host_dst else cb
+        scratch = cb if (s_d2h or s_h2d) else 0
+        steps.append(ReshardStep("host_stage", c, lo, hi,
+                                 scratch_bytes=scratch,
+                                 nbytes_h2d=s_h2d, nbytes_d2h=s_d2h))
+        peak = max(peak, scratch)
+        h2d += s_h2d
+        d2h += s_d2h
+    return ReshardPlan(global_shape, itemsize, src, dst, move_axis, kind,
+                       n_chunks, tuple(steps), 0, None, None, peak,
+                       row_bytes, budget, spilled=True, host_dst=host_dst,
+                       nbytes_h2d=h2d, nbytes_d2h=d2h,
+                       dst_device_bytes=dst_device_bytes)
+
+
+def _chunk_hint_spilled(width: int, n_shards: int) -> Optional[int]:
+    """Tuned chunk count for a spilled plan: the max of the op
+    ``"reshard"`` and op ``"spill"`` hints — a chunk count banked for
+    the device planner still means "stream this width finer", and the
+    spill space can override it upward."""
+    hints = [_chunk_hint(width, n_shards)]
+    try:
+        from . import spill as _spill
+        hints.append(_spill.chunk_hint_spill(width, n_shards))
+    except Exception:
+        pass
+    vals = [int(h) for h in hints if h]
+    return max(vals) if vals else None
 
 
 def _chunk_hint(width: int, n_shards: int) -> Optional[int]:
@@ -454,6 +584,12 @@ def _run_plan(plan: ReshardPlan, dst, *, src=None, host_value=None):
         out = _place_piece(out, piece, lo, hi, dst, move)
         if not _is_tracer(out):
             out = dst._place(out)   # re-pin so scratch stays chunk-bounded
+            if jax.default_backend() != "tpu":
+                # the CPU-sim collective rendezvous starves (and
+                # deadlocks) when many compiled chunk programs are in
+                # flight at once; TPU device-ordered execution needs no
+                # per-chunk sync, so only the simulator pays it
+                jax.block_until_ready(out)
     return dst._place(out)
 
 
@@ -463,11 +599,51 @@ def _layout_of(x) -> Layout:
     return Layout.replicated(x.n_shards, x.partition)
 
 
+def _dst_layout(global_shape, n_shards: int, partition: Partition,
+                axis: int, local_shapes):
+    """Destination :class:`Layout` plus the normalized ``(axis,
+    local_shapes)`` WITHOUT constructing the array — the spilled
+    host-destination path must not allocate the (oversized) device
+    buffer just to read its metadata. Validation mirrors the
+    :class:`~pylops_mpi_tpu.DistributedArray` constructor."""
+    axis = int(axis)
+    if axis < 0:
+        axis += len(global_shape)
+    if partition == Partition.SCATTER and not (0 <= axis < len(global_shape)):
+        raise IndexError(f"axis {axis} out of range for shape {global_shape}")
+    if local_shapes is None:
+        lsh = local_split(global_shape, n_shards, partition, axis)
+    else:
+        lsh = tuple(tuple(int(v) for v in np.atleast_1d(s))
+                    for s in local_shapes)
+        if len(lsh) != n_shards:
+            raise ValueError(f"need {n_shards} local shapes, got {len(lsh)}")
+        if partition == Partition.SCATTER:
+            tot = sum(s[axis] for s in lsh)
+            if tot != global_shape[axis]:
+                raise ValueError(
+                    f"local shapes sum to {tot} != global dim "
+                    f"{global_shape[axis]}")
+    if partition == Partition.SCATTER:
+        return Layout.scatter(tuple(s[axis] for s in lsh), axis), axis, lsh
+    return Layout.replicated(n_shards, partition), axis, lsh
+
+
 def _span_and_run(plan: ReshardPlan, dst, *, src=None, host_value=None,
-                  op: str = "reshard"):
+                  host_out=None, overlap=None, op: str = "reshard"):
     tags = dict(cat="collective", op=op, kind=plan.kind,
                 chunks=plan.chunks, shape=plan.global_shape,
                 peak_scratch=plan.peak_scratch)
+    if plan.spilled:
+        from . import spill as _spill
+        seq = _count_collective("reshard", nbytes_h2d=plan.nbytes_h2d,
+                                nbytes_d2h=plan.nbytes_d2h)
+        tags.update(spilled=True, h2d_bytes=plan.nbytes_h2d,
+                    d2h_bytes=plan.nbytes_d2h, host_dst=plan.host_dst)
+        with _trace.span("collective.reshard", seq=seq, **tags):
+            return _spill.run_spilled(plan, dst=dst, host_out=host_out,
+                                      src=src, host_value=host_value,
+                                      overlap=overlap)
     if plan.nbytes_ici is not None:
         seq = _count_collective("reshard", nbytes_ici=plan.nbytes_ici,
                                 nbytes_dcn=plan.nbytes_dcn)
@@ -484,19 +660,36 @@ def reshard(x, *, mesh: Optional[Mesh] = None,
             partition: Optional[Partition] = None,
             axis: Optional[int] = None,
             local_shapes=None, budget=_UNSET,
-            chunks: Optional[int] = None):
-    """Move a :class:`~pylops_mpi_tpu.DistributedArray` to a new
-    layout — partition policy, shard axis, ragged split, and/or a
-    different mesh (shrink/grow) — with peak scratch bounded by the
-    budget. Same-device-set moves are jit-safe; cross-mesh moves
-    transfer one chunk at a time and require concrete inputs.
+            chunks: Optional[int] = None, spill: Optional[str] = None,
+            overlap: Optional[str] = None,
+            host_dst: Optional[bool] = None):
+    """Move a :class:`~pylops_mpi_tpu.DistributedArray` (or a
+    host-resident :class:`~pylops_mpi_tpu.parallel.spill.HostArray`)
+    to a new layout — partition policy, shard axis, ragged split,
+    and/or a different mesh (shrink/grow) — with peak scratch bounded
+    by the budget. Same-device-set moves are jit-safe; cross-mesh
+    moves transfer one chunk at a time and require concrete inputs.
 
     A mask only survives a move that keeps the shard count (mask
     colors are per-shard); the planner refuses otherwise, as it
     refuses a SCATTER target whose axis is shorter than the new shard
     count — both mirror the checkpoint elastic-restore refusals, so
-    callers can fall back to the same checkpoint path."""
+    callers can fall back to the same checkpoint path.
+
+    Round 14: ``spill``/``overlap``/``host_dst`` thread through to the
+    host-staging tier (see :func:`plan_reshard` and
+    :mod:`~pylops_mpi_tpu.parallel.spill`). A concrete over-budget
+    move runs host-staged instead of refusing (mode ``auto``), and a
+    destination too large for the device budget comes back as a
+    :class:`~pylops_mpi_tpu.parallel.spill.HostArray`; traced moves
+    never spill."""
     from ..distributedarray import DistributedArray
+    from . import spill as _spill
+    if isinstance(x, _spill.HostArray):
+        return _spill.reshard_from_host(
+            x, mesh=mesh, partition=partition, axis=axis,
+            local_shapes=local_shapes, budget=budget, chunks=chunks,
+            spill=spill, overlap=overlap, host_dst=host_dst)
     tgt_mesh = mesh if mesh is not None else x.mesh
     tgt_part = partition if partition is not None else x.partition
     tgt_axis = x.axis if axis is None else int(axis)
@@ -525,27 +718,45 @@ def reshard(x, *, mesh: Optional[Mesh] = None,
             f"reshard: array carries a mask (per-shard group colors) and "
             f"the move changes the shard count {x.n_shards} -> {n_new}; "
             "drop the mask or re-derive it for the new world first", 0)
-    out = DistributedArray(x.global_shape, tgt_mesh, tgt_part, tgt_axis,
-                           local_shapes=local_shapes, mask=x.mask,
-                           dtype=x.dtype)
+    # destination metadata WITHOUT constructing the array: a spilled
+    # host destination must never allocate the oversized device buffer
+    dst_l, ax_n, lsh = _dst_layout(x.global_shape, n_new, tgt_part,
+                                   tgt_axis, local_shapes)
     # no-op fast path: identical layout on the same devices
     if (_same_devices(x.mesh, tgt_mesh) and tgt_part == x.partition
             and (tgt_part != Partition.SCATTER
-                 or (out._axis == x._axis
-                     and out._axis_sizes == x._axis_sizes))):
+                 or (ax_n == x._axis
+                     and dst_l.sizes == x._axis_sizes))):
+        out = DistributedArray(x.global_shape, tgt_mesh, tgt_part, tgt_axis,
+                               local_shapes=local_shapes, mask=x.mask,
+                               dtype=x.dtype)
         out._arr = x._arr + 0
         return out
     plan = plan_reshard(x.global_shape, np.dtype(x.dtype).itemsize,
-                        _layout_of(x), _layout_of(out), budget=budget,
-                        chunks=chunks, slice_ids=_topo.slice_map(tgt_mesh))
-    out._arr = _span_and_run(plan, out, src=x)
+                        _layout_of(x), dst_l, budget=budget,
+                        chunks=chunks, slice_ids=_topo.slice_map(tgt_mesh),
+                        spill=("off" if _is_tracer(x._arr) else spill),
+                        dst_host=host_dst,
+                        topo_key=_topo.topology_key(tgt_mesh))
+    if plan.spilled and plan.host_dst:
+        host_out = np.empty(x.global_shape, dtype=x.dtype)
+        _span_and_run(plan, None, src=x, host_out=host_out,
+                      overlap=overlap)
+        return _spill.HostArray(host_out, tgt_mesh, tgt_part, ax_n,
+                                local_shapes=lsh, mask=x.mask)
+    out = DistributedArray(x.global_shape, tgt_mesh, tgt_part, tgt_axis,
+                           local_shapes=local_shapes, mask=x.mask,
+                           dtype=x.dtype)
+    out._arr = _span_and_run(plan, out, src=x, overlap=overlap)
     return out
 
 
 def place_replica(value, mesh: Mesh,
                   partition: Partition = Partition.SCATTER, axis: int = 0,
                   local_shapes=None, mask=None, budget=_UNSET,
-                  chunks: Optional[int] = None, dtype=None):
+                  chunks: Optional[int] = None, dtype=None,
+                  spill: Optional[str] = None,
+                  overlap: Optional[str] = None):
     """Place a host-replicated logical value (a numpy array every
     surviving process holds, e.g. a banked solver-carry field) onto
     ``mesh`` as a fresh :class:`~pylops_mpi_tpu.DistributedArray`,
@@ -560,8 +771,11 @@ def place_replica(value, mesh: Mesh,
     plan = plan_reshard(value.shape, out.dtype.itemsize,
                         Layout.replicated(1), _layout_of(out),
                         budget=budget, chunks=chunks,
-                        slice_ids=_topo.slice_map(mesh))
-    out._arr = _span_and_run(plan, out, host_value=value, op="place_replica")
+                        slice_ids=_topo.slice_map(mesh),
+                        spill=spill, src_host=True, dst_host=False,
+                        topo_key=_topo.topology_key(mesh))
+    out._arr = _span_and_run(plan, out, host_value=value, overlap=overlap,
+                             op="place_replica")
     return out
 
 
@@ -583,11 +797,15 @@ def reshard_raw(x: jax.Array, mesh: Mesh, old_axis: int, new_axis: int, *,
     from .collectives import all_to_all_resharding
     from ..resilience import faults as _faults
     n_dev = int(mesh.devices.size)
+    # spill="off": this path is trace-safe by contract — a host-staged
+    # schedule (concrete device_get) can never run under a trace, so
+    # an impossible budget keeps the round-13 refusal here
     plan = plan_reshard(
         x.shape, x.dtype.itemsize,
         Layout.scatter(_ceil_sizes(x.shape[old_axis], n_dev), old_axis),
         Layout.scatter(_ceil_sizes(x.shape[new_axis], n_dev), new_axis),
-        budget=budget, chunks=chunks, slice_ids=_topo.slice_map(mesh))
+        budget=budget, chunks=chunks, slice_ids=_topo.slice_map(mesh),
+        spill="off", topo_key=_topo.topology_key(mesh))
     if plan.nbytes_ici is not None:
         seq = _count_collective("reshard", nbytes_ici=plan.nbytes_ici,
                                 nbytes_dcn=plan.nbytes_dcn)

@@ -42,7 +42,8 @@ from typing import Callable, Dict, Optional
 __all__ = ["arm", "disarm", "armed", "consume", "fault_signature",
            "inject_nan", "inject_stall", "host_stall",
            "corrupt_plan_cache", "flaky",
-           "maybe_kill_reshard", "reset_reshard_steps", "reshard_steps"]
+           "maybe_kill_reshard", "reset_reshard_steps", "reshard_steps",
+           "maybe_kill_spill", "reset_spill_steps", "spill_steps"]
 
 _LOCK = threading.Lock()
 _ARMED: Optional[Dict] = None
@@ -81,6 +82,47 @@ def maybe_kill_reshard() -> None:
         count = _RESHARD_STEPS["count"]
     import os
     raw = os.environ.get(KILL_RESHARD_ENV, "").strip()
+    if not raw:
+        return
+    if count >= int(raw):
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+# --------------------------------------------- kill-mid-spill seam
+# Round 14: the host-staging executor (parallel/spill.py) calls
+# :func:`maybe_kill_spill` once per ``host_stage`` step. With
+# PYLOPS_MPI_TPU_FAULT_KILL_SPILL=<N> set, the process SIGKILLs itself
+# when the process-global step counter reaches N (1-based) — a worker
+# dying mid-spill, which the checkpoint-relaunch ladder must survive
+# exactly as it survives a kill mid-reshard. Unset (the default) the
+# seam is a counter bump only.
+_SPILL_STEPS = {"count": 0}
+KILL_SPILL_ENV = "PYLOPS_MPI_TPU_FAULT_KILL_SPILL"
+
+
+def reset_spill_steps() -> None:
+    with _LOCK:
+        _SPILL_STEPS["count"] = 0
+
+
+def spill_steps() -> int:
+    """Host-stage steps executed in this process since the last reset."""
+    with _LOCK:
+        return _SPILL_STEPS["count"]
+
+
+def maybe_kill_spill() -> None:
+    """Advance the host-stage step counter; SIGKILL this process when
+    it reaches ``PYLOPS_MPI_TPU_FAULT_KILL_SPILL`` (1-based). SIGKILL —
+    not an exception — for the same reason as
+    :func:`maybe_kill_reshard`: the rehearsed fault is a dead worker,
+    and nothing must get a chance to tidy up."""
+    with _LOCK:
+        _SPILL_STEPS["count"] += 1
+        count = _SPILL_STEPS["count"]
+    import os
+    raw = os.environ.get(KILL_SPILL_ENV, "").strip()
     if not raw:
         return
     if count >= int(raw):

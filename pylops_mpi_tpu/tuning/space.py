@@ -485,3 +485,14 @@ register_space(TuningSpace(
     note="chunk counts for the bounded-memory resharding planner "
          "(parallel/reshard.py); the budget sets the floor, a banked "
          "plan can only stream finer"))
+
+register_space(TuningSpace(
+    op="spill",
+    axes=(Axis("comm_chunks", (1, 2, 4, 8)),
+          Axis("overlap", ("on", "off"))),
+    cost=None,
+    note="host-staging schedules of the spill tier "
+         "(parallel/spill.py): chunk counts for the budget-sized "
+         "device_get/device_put stream and the double-buffer overlap "
+         "choice (on = fetch of chunk k+1 rides behind the placement "
+         "of chunk k); the budget stays the floor on chunk counts"))

@@ -98,6 +98,27 @@ def _target_n_shards(mesh) -> int:
     return int(mesh.devices.size)
 
 
+def _budgeted_restore() -> bool:
+    """True when ``PYLOPS_MPI_TPU_RESHARD_BUDGET`` is set: the
+    mesh-elastic restore then streams its placement through the
+    bounded planner (``place_replica`` — host-staged under the
+    round-14 spill tier when the budget demands it) instead of the
+    legacy one-shot ``to_dist``. Unset keeps the legacy path
+    bit-identical."""
+    from ..parallel.reshard import reshard_budget
+    try:
+        return reshard_budget() is not None
+    except ValueError:
+        return False
+
+
+def _resolve_mesh(mesh):
+    if mesh is None:
+        from ..parallel.mesh import default_mesh
+        return default_mesh()
+    return mesh
+
+
 def _check_elastic(partition: Partition, axis: int,
                    global_shape: Tuple[int, ...], mask, n_old: int,
                    n_new: int) -> None:
@@ -135,6 +156,15 @@ def _decode(v, mesh=None):
                          backend="native", partition=partition.name,
                          axis=axis, n_old=n_old, n_new=n_new,
                          global_shape=list(np.shape(v["value"])))
+            if _budgeted_restore():
+                # round 14: a scratch budget is set, so stream the
+                # placement through the bounded planner (host-staged
+                # when the budget demands it) instead of the one-shot
+                # to_dist device_put
+                from ..parallel import reshard as _reshard
+                return _reshard.place_replica(
+                    np.asarray(v["value"]), _resolve_mesh(mesh),
+                    partition, axis, mask=mask)
             local_shapes = None  # balanced local_split on the new mesh
         out = DistributedArray.to_dist(
             v["value"], mesh=mesh, partition=partition,
@@ -326,6 +356,12 @@ def _load_orbax(path: str, mesh=None) -> Dict[str, Any]:
                                   axis=axis)
             else:  # broadcast: the physical buffer IS the global array
                 logical = phys
+            if _budgeted_restore():
+                # round 14: stream the elastic placement through the
+                # bounded planner instead of the one-shot to_dist
+                from ..parallel import reshard as _reshard
+                return _reshard.place_replica(logical, mesh,
+                                              partition, axis)
             return DistributedArray.to_dist(
                 logical, mesh=mesh, partition=partition, axis=axis,
                 local_shapes=None, mask=None)

@@ -110,6 +110,12 @@ KNOBS = [
      "peak per-device scratch ceiling of the resharding planner; a "
      "move that cannot fit refuses with the minimum budget that "
      "would succeed"),
+    ("PYLOPS_MPI_TPU_SPILL", "auto|on|off", "auto",
+     "utils/deps.py (parallel/reshard.py, parallel/spill.py)",
+     "host-RAM spill tier for the resharding planner: auto converts "
+     "only would-refuse moves into double-buffered host-staged "
+     "schedules, on forces host staging for every concrete move, off "
+     "keeps the round-13 refusal behavior bit-identical"),
     ("PYLOPS_MPI_TPU_HIERARCHICAL", "auto|on|off", "auto",
      "utils/deps.py (parallel/topology.py, "
      "ops/matrixmult|fft|stack|halo|derivatives)",
@@ -255,6 +261,11 @@ KNOBS = [
      "chaos seam: SIGKILL this process when the reshard-step counter "
      "reaches N — rehearses a worker dying mid-reshard so the "
      "checkpoint fallback path stays proven"),
+    ("PYLOPS_MPI_TPU_FAULT_KILL_SPILL", "int>=1", "unset (off)",
+     "resilience/faults.py (parallel/spill.py)",
+     "chaos seam: SIGKILL this process when the host-stage step "
+     "counter reaches N — rehearses a worker dying mid-spill so the "
+     "checkpoint fallback path stays proven"),
     ("PYLOPS_MPI_TPU_METRICS", "off|on", "off",
      "diagnostics/metrics.py (solvers, collectives, resilience, "
      "tuning)",
@@ -380,6 +391,34 @@ def overlap_env_pinned() -> bool:
     autotuner's plans, exactly like an explicit ``overlap=`` kwarg
     (``auto``/unset leaves the plan seam free to decide)."""
     return overlap_mode() in ("on", "off")
+
+
+_warned_spill = False
+
+
+def spill_mode() -> str:
+    """``PYLOPS_MPI_TPU_SPILL`` resolved to ``auto``/``on``/``off``
+    (unknown values fall back to ``auto`` with a one-time warning,
+    same contract as :func:`overlap_mode`). ``off`` keeps the round-13
+    planner refusal behavior bit-identical; ``auto`` (the default)
+    converts ONLY moves the device planner would refuse into
+    host-staged schedules — every currently-succeeding path keeps its
+    device plan untouched; ``on`` forces host staging for every
+    concrete cross-layout move (the CI rehearsal mode — traced moves
+    never spill, a ``device_get`` needs a concrete array)."""
+    global _warned_spill
+    m = os.environ.get("PYLOPS_MPI_TPU_SPILL", "auto").strip().lower()
+    if m in ("", "none", "default"):
+        m = "auto"
+    if m not in ("auto", "on", "off"):
+        if not _warned_spill:
+            import warnings
+            warnings.warn(
+                f"PYLOPS_MPI_TPU_SPILL={m!r} is not one of "
+                "['auto', 'on', 'off']; using 'auto'", stacklevel=2)
+            _warned_spill = True
+        m = "auto"
+    return m
 
 
 _warned_hier = False

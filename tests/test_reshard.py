@@ -35,6 +35,10 @@ F64 = np.dtype(np.float64).itemsize
 @pytest.fixture(autouse=True)
 def _clean(monkeypatch):
     monkeypatch.delenv("PYLOPS_MPI_TPU_RESHARD_BUDGET", raising=False)
+    # this file pins the DEVICE planner (chunk accounting, nbytes,
+    # refusal messages); the spill-forced mirror of the same matrix
+    # lives in test_spill.py, so a CI leg's SPILL=on must not leak in
+    monkeypatch.delenv("PYLOPS_MPI_TPU_SPILL", raising=False)
     yield
     set_default_mesh(None)
 

@@ -644,6 +644,62 @@ def test_chaos_kill_mid_reshard_falls_back(tmp_path):
     assert rel < 1e-6, rel
 
 
+@pytest.mark.slow
+def test_chaos_kill_mid_spill_falls_back(tmp_path):
+    """ISSUE 14 satellite: the survivor is killed MID-SPILL — with
+    ``PYLOPS_MPI_TPU_SPILL=on`` the in-place restore's placement is
+    host-staged, and the ``faults.maybe_kill_spill`` seam SIGKILLs on
+    its first ``host_stage`` step. The job still completes through the
+    checkpoint-relaunch fallback with zero divergence: the checkpoint
+    restore path never touches the concrete planner (no budget env is
+    set), so the relaunched worker survives the same env."""
+    ckpt = str(tmp_path / "carry.orbax")
+    out = str(tmp_path / "final_x.npy")
+    mark = str(tmp_path / "epoch.mark")
+    tracef = str(tmp_path / "worker.trace.jsonl")
+    env = {"PYLOPS_ELASTIC_CKPT": ckpt, "PYLOPS_ELASTIC_OUT": out,
+           "PYLOPS_ELASTIC_EPOCH_MARK": mark,
+           "PYLOPS_ELASTIC_EPOCH_SLEEP": "2.0",
+           "PYLOPS_MPI_TPU_TRACE": "spans",
+           "PYLOPS_MPI_TPU_TRACE_FILE": tracef,
+           "PYLOPS_MPI_TPU_SPILL": "on",
+           "PYLOPS_MPI_TPU_FAULT_KILL_SPILL": "1",
+           "XLA_FLAGS": " ".join(
+               f for f in os.environ.get("XLA_FLAGS", "").split()
+               if "force_host_platform_device_count" not in f)}
+    killed = []
+
+    def on_poll(attempt, workers):
+        if not killed and os.path.exists(mark):
+            for w in workers:
+                if w.slot == 1 and w.alive():
+                    w.proc.send_signal(signal.SIGKILL)
+                    killed.append(w.slot)
+
+    budget = stage_budget("multihost_chaos", rehearse=True)
+    r = launch_job([os.path.join(ROOT, "tests", "elastic_worker.py")],
+                   2, heartbeat_interval=0.4, stale_factor=2.0,
+                   on_poll=on_poll, job_timeout_s=budget, env=env,
+                   inplace=True, shrink=False, max_relaunches=2)
+    assert r.ok, (r.failures, {k: v[-2000:] for k, v in r.outputs.items()})
+    # launch + in-place reconfig (killed mid-spill) + checkpoint relaunch
+    assert r.attempts == 3 and r.world_size == 1
+    assert [f.kind for f in r.failures] == ["signal", "signal"]
+    assert [f.slot for f in r.failures] == [1, 0]
+    assert "ELASTIC OK" in r.outputs[0]
+
+    # the relaunched worker resumed from the checkpoint: its trace has
+    # the read, and no in-place recovery
+    names = _trace_names(tracef)
+    assert "checkpoint.load" in names
+    assert "resilience.inplace_recovery" not in names
+
+    ref = _uninterrupted_reference()
+    got = np.load(out)
+    rel = np.max(np.abs(got - ref)) / np.max(np.abs(ref))
+    assert rel < 1e-6, rel
+
+
 def _uninterrupted_reference():
     """The chaos worker's exact problem (seed 0, f64), solved
     uninterrupted with the same segmented schedule."""
