@@ -530,6 +530,186 @@ def _spill_race_row():
             pass
 
 
+def _precond_race_row():
+    """Preconditioned-solver race (preconditioner-PR acceptance bar):
+    an ill-conditioned 2-D Laplacian-regularized CGLS solve, run
+    unpreconditioned, with the block-Jacobi preconditioner, and with
+    the 2-level V-cycle. Stamps iterations-to-tol for each arm plus
+    the headline ratios — the acceptance gate is block-Jacobi
+    ``iters_ratio <= 0.5`` with a wall-clock win. Error-isolated: a
+    preconditioner failure reports itself, never costs the headline."""
+    try:
+        import numpy as _np
+        import jax as _jax
+        import jax.numpy as _jnp
+        from pylops_mpi_tpu import DistributedArray
+        from pylops_mpi_tpu.linearoperator import MPILinearOperator
+        from pylops_mpi_tpu.ops.precond import make_precond
+        from pylops_mpi_tpu.solvers import cgls
+
+        dims = (24, 24)
+        n = dims[0] * dims[1]
+        eps = 0.05   # small regularization → large condition number
+
+        def _lap_factory(d):
+            """Dirichlet 5-point Laplacian on grid ``d`` (symmetric —
+            one-sided boundary stencils would break CG/MG)."""
+            class _Lap(MPILinearOperator):
+                accepts_block = True
+                dims_ = d
+
+                def __init__(self):
+                    nn = d[0] * d[1]
+                    super().__init__(shape=(nn, nn),
+                                     dtype=_np.dtype("float32"))
+
+                def _apply(self, x):
+                    arr = x._global() if hasattr(x, "_global") else x
+                    g = arr.reshape(d)
+                    p = _jnp.pad(g, 1)
+                    out = (4.0 * g - p[:-2, 1:-1] - p[2:, 1:-1]
+                           - p[1:-1, :-2] - p[1:-1, 2:])
+                    flat = (eps * arr.reshape(-1)
+                            + out.reshape(-1)).astype(arr.dtype)
+                    if hasattr(x, "_global"):
+                        return DistributedArray._wrap(
+                            x._from_global(flat), x)
+                    return flat
+
+                _matvec = _apply
+                _rmatvec = _apply
+            return _Lap()
+
+        Op = _lap_factory(dims)
+        rng = _np.random.default_rng(11)
+        xt = rng.standard_normal(n).astype(_np.float32)
+        yv = _np.asarray(Op.matvec(
+            DistributedArray.to_dist(xt)).asarray())
+        y = DistributedArray.to_dist(yv)
+        niter = 400
+        rtol = 1e-3
+        g0 = _np.asarray(Op.rmatvec(
+            DistributedArray.to_dist(yv)).asarray())
+
+        # exact diagonal blocks of the normal operator AᴴA (CGLS
+        # preconditions the normal system; the mod-m probe would alias
+        # the ±row couplings of the squared stencil into the blocks)
+        from pylops_mpi_tpu.ops.precond import BlockJacobiPrecond
+        Ad = _np.asarray(Op.todense(), dtype=_np.float64)
+        Nd = Ad.T @ Ad
+        m = dims[1]
+        blocks = _np.stack([Nd[i * m:(i + 1) * m, i * m:(i + 1) * m]
+                            for i in range(n // m)])
+        bj = BlockJacobiPrecond(blocks.astype(_np.float32))
+        vc = make_precond(Op, kind="mg", op_factory=_lap_factory,
+                          dims=dims, levels=2)
+
+        def _arm(M):
+            # the fused stop test is absolute in the M-norm (kold =
+            # g·Mg), so each arm's tol comes from its own kold0 — the
+            # standard relative-residual PCG criterion, identical
+            # reduction factor on every arm
+            z0 = (g0 if M is None else _np.asarray(M.matvec(
+                DistributedArray.to_dist(g0)).asarray()))
+            tol = float(rtol ** 2 * _np.dot(g0, z0))
+
+            def run():
+                out = cgls(Op, y, niter=niter, tol=tol, M=M)
+                _jax.block_until_ready(out[0]._arr)
+                return out
+            out = run()                      # compile outside timing
+            t0 = time.perf_counter()
+            out = run()
+            t = time.perf_counter() - t0
+            xs = _np.asarray(out[0].asarray())
+            err = float(_np.linalg.norm(xs - xt)
+                        / _np.linalg.norm(xt))
+            return int(out[2]), t, err
+
+        it0, t0s, e0 = _arm(None)
+        itb, tbs, eb = _arm(bj)
+        itv, tvs, ev = _arm(vc)
+        return {
+            "problem": {"dims": list(dims), "eps": eps,
+                        "niter_cap": niter},
+            "unpreconditioned": {"iters": it0, "wall_s": _sig3(t0s),
+                                 "rel_err": _sig3(e0),
+                                 "solves_per_sec": _sig3(1.0 / t0s)},
+            "block_jacobi": {"iters": itb, "wall_s": _sig3(tbs),
+                             "rel_err": _sig3(eb),
+                             "solves_per_sec": _sig3(1.0 / tbs)},
+            "vcycle": {"iters": itv, "wall_s": _sig3(tvs),
+                       "rel_err": _sig3(ev),
+                       "solves_per_sec": _sig3(1.0 / tvs)},
+            "bj_iters_ratio": _sig3(itb / it0) if it0 else None,
+            "vc_iters_ratio": _sig3(itv / it0) if it0 else None,
+            "bj_wall_speedup": _sig3(t0s / tbs) if tbs else None,
+            "vc_wall_speedup": _sig3(t0s / tvs) if tvs else None,
+        }
+    except Exception as e:  # the race must never cost the headline
+        return {"error": repr(e)[:300]}
+
+
+def _sparse_race_row():
+    """Sparse-vs-dense matvec race (sparse-tier acceptance bar): at
+    ≥90% sparsity the triplet operator's forward+adjoint sweep against
+    the dense SUMMA/block operator on the same matrix. Stamps the byte
+    ratio the tier-selection cost model reasons from and the measured
+    wall ratio. Error-isolated like every race row."""
+    try:
+        import numpy as _np
+        import jax as _jax
+        from pylops_mpi_tpu import DistributedArray
+        from pylops_mpi_tpu.ops.matrixmult import MPIMatrixMult
+        from pylops_mpi_tpu.ops.sparse import MPISparseMatrixMult
+
+        N = M = 512
+        density = 0.05           # 95% sparse — well past the 90% gate
+        rng = _np.random.default_rng(13)
+        A = (rng.standard_normal((N, M))
+             * (rng.random((N, M)) < density)).astype(_np.float32)
+        Sp = MPISparseMatrixMult.from_dense(A)
+        De = MPIMatrixMult(A, 1, dtype=_np.float32)
+        x = DistributedArray.to_dist(
+            rng.standard_normal(M).astype(_np.float32))
+        y = DistributedArray.to_dist(
+            rng.standard_normal(N).astype(_np.float32))
+
+        def _sweep(op):
+            def run():
+                f = op.matvec(x)
+                a = op.rmatvec(y)
+                _jax.block_until_ready((f._arr, a._arr))
+                return f, a
+            run()                            # compile outside timing
+            reps = 20
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                f, a = run()
+            t = (time.perf_counter() - t0) / reps
+            return t, f, a
+
+        t_sp, f_sp, a_sp = _sweep(Sp)
+        t_de, f_de, a_de = _sweep(De)
+        err = max(
+            float(_np.max(_np.abs(_np.asarray(f_sp.asarray())
+                                  - _np.asarray(f_de.asarray())))),
+            float(_np.max(_np.abs(_np.asarray(a_sp.asarray())
+                                  - _np.asarray(a_de.asarray())))))
+        it = _np.dtype(_np.float32).itemsize
+        bytes_ratio = (Sp.nnz * (it + 8)) / (N * M * it)
+        return {
+            "shape": [N, M], "density": _sig3(Sp.density),
+            "nnz": int(Sp.nnz),
+            "sparse_sweep_s": _sig3(t_sp), "dense_sweep_s": _sig3(t_de),
+            "sparse_vs_dense_wall": _sig3(t_sp / t_de) if t_de else None,
+            "bytes_ratio": _sig3(bytes_ratio),
+            "max_abs_diff": _sig3(err),
+        }
+    except Exception as e:  # the race must never cost the headline
+        return {"error": repr(e)[:300]}
+
+
 # dense matmul peak per chip, TFLOP/s (bf16 inputs, f32 accumulation on
 # the MXU) — public spec-sheet numbers; most-specific key checked first
 _PEAK_TFLOPS = [
@@ -1184,6 +1364,25 @@ def child_main():
         _progress("spill race (host-staged oversized reshard)")
         spill_race = _spill_race_row()
 
+    # preconditioned-solver race (preconditioner PR): ill-conditioned
+    # Laplacian-regularized CGLS, bare vs block-Jacobi vs V-cycle;
+    # every CPU-sim round, BENCH_PRECOND_PYLOPS_MPI_TPU=1 forces it on
+    # hardware too
+    precond_race = None
+    precond_env = os.environ.get("BENCH_PRECOND_PYLOPS_MPI_TPU", "")
+    if precond_env != "0" and (not on_tpu or precond_env == "1"):
+        _progress("preconditioner race (bare vs block-Jacobi vs MG)")
+        precond_race = _precond_race_row()
+
+    # sparse-vs-dense matvec race (sparse-tier PR): 95%-sparse matrix,
+    # triplet operator vs dense block operator; every CPU-sim round,
+    # BENCH_SPARSE_PYLOPS_MPI_TPU=1 forces it on hardware too
+    sparse_race = None
+    sparse_env = os.environ.get("BENCH_SPARSE_PYLOPS_MPI_TPU", "")
+    if sparse_env != "0" and (not on_tpu or sparse_env == "1"):
+        _progress("sparse-vs-dense matvec race (95% sparsity)")
+        sparse_race = _sparse_race_row()
+
     peak_bf16 = _peak_flops_per_chip(jax.devices()[0], "bf16")
     peak_f32 = _peak_flops_per_chip(jax.devices()[0], "f32_highest")
     peak_hbm = _peak_hbm_gbps(jax.devices()[0]) if on_tpu else None
@@ -1336,6 +1535,8 @@ def child_main():
         **({"serving": serving_row} if serving_row else {}),
         **({"hierarchical_vs_flat": hier_race} if hier_race else {}),
         **({"spill_oversized": spill_race} if spill_race else {}),
+        **({"precond": precond_race} if precond_race else {}),
+        **({"sparse_vs_dense": sparse_race} if sparse_race else {}),
         **({"selfcheck": selfcheck} if selfcheck is not None else {}),
         **({"cpu_breakdown": cpu_breakdown} if cpu_breakdown else {}),
     }
@@ -1549,7 +1750,8 @@ def _merge_tpu_cache(result, root=None):
                              "cpu_breakdown", "flagship_1dev_cpu",
                              "roofline", "f32", "bf16", "plan",
                              "spill", "tune_race", "batched", "serving",
-                             "hierarchical_vs_flat", "spill_oversized")
+                             "hierarchical_vs_flat", "spill_oversized",
+                             "precond", "sparse_vs_dense")
                             if k in result}
                 result = dict(r)
                 result["cached"] = True
@@ -1581,6 +1783,14 @@ def _merge_tpu_cache(result, root=None):
                 if cpu_live.get("spill_oversized") is not None:
                     result["spill_oversized"] = \
                         cpu_live["spill_oversized"]
+                # and the preconditioner + sparse-tier races: live
+                # CPU-sim iterations-to-tol / byte-ratio evidence that
+                # rides every compact line
+                if cpu_live.get("precond") is not None:
+                    result["precond"] = cpu_live["precond"]
+                if cpu_live.get("sparse_vs_dense") is not None:
+                    result["sparse_vs_dense"] = \
+                        cpu_live["sparse_vs_dense"]
                 result.setdefault("plan", "default")
                 # a legacy banked artifact predating the spill tier ran
                 # under the round-13 refusal semantics
@@ -2030,6 +2240,28 @@ def _compact_line(result):
         ) if v is not None}
     elif hr.get("error"):
         compact["hier"] = {"error": hr["error"][:120]}
+    pr = result.get("precond") or {}
+    if pr and not pr.get("error"):
+        compact["precond"] = {k: v for k, v in (
+            ("bare_iters", (pr.get("unpreconditioned") or {})
+             .get("iters")),
+            ("bj_iters", (pr.get("block_jacobi") or {}).get("iters")),
+            ("vc_iters", (pr.get("vcycle") or {}).get("iters")),
+            ("bj_iters_ratio", pr.get("bj_iters_ratio")),
+            ("vc_iters_ratio", pr.get("vc_iters_ratio")),
+            ("bj_wall_speedup", pr.get("bj_wall_speedup")),
+            ("vc_wall_speedup", pr.get("vc_wall_speedup")),
+        ) if v is not None}
+    elif pr.get("error"):
+        compact["precond"] = {"error": pr["error"][:120]}
+    sv = result.get("sparse_vs_dense") or {}
+    if sv and not sv.get("error"):
+        compact["sparse_vs_dense"] = {
+            k: sv.get(k) for k in
+            ("density", "sparse_vs_dense_wall", "bytes_ratio",
+             "max_abs_diff") if sv.get(k) is not None}
+    elif sv.get("error"):
+        compact["sparse_vs_dense"] = {"error": sv["error"][:120]}
     rl = result.get("roofline") or {}
     if rl and not rl.get("error"):
         compact["roofline"] = {

@@ -69,6 +69,13 @@ PAGES = {
          ["MPIFredholm1", "MPINonStationaryConvolve1D", "MPIFFT2D",
           "MPIFFTND"]),
         ("Wave-equation processing", "pylops_mpi_tpu", ["MPIMDC"]),
+        ("Preconditioners", "pylops_mpi_tpu",
+         ["JacobiPrecond", "BlockJacobiPrecond", "VCyclePrecond",
+          "make_precond"]),
+        ("Diagonal probing", "pylops_mpi_tpu.ops.precond",
+         ["probe_diagonal"]),
+        ("Sparse tier", "pylops_mpi_tpu",
+         ["MPISparseMatrixMult", "auto_sparse_matmult"]),
     ],
     "solvers": [
         ("Basic", "pylops_mpi_tpu",
@@ -90,6 +97,8 @@ PAGES = {
           "last_status"]),
         ("Escalation driver", "pylops_mpi_tpu.resilience",
          ["resilient_solve", "ResilientResult"]),
+        ("Iterative refinement", "pylops_mpi_tpu.resilience",
+         ["refined_solve", "RefinedResult"]),
         ("Bounded retry", "pylops_mpi_tpu.resilience.retry",
          ["retry_call", "default_retries", "default_backoff_s",
           "default_jitter"]),

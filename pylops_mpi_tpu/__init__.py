@@ -34,6 +34,9 @@ from .ops.nonstatconv import MPINonStationaryConvolve1D
 from .ops.fft import MPIFFTND, MPIFFT2D
 from .ops.fredholm import MPIFredholm1
 from .ops.mdc import MPIMDC
+from .ops.precond import (JacobiPrecond, BlockJacobiPrecond,
+                          VCyclePrecond, make_precond)
+from .ops.sparse import MPISparseMatrixMult, auto_sparse_matmult
 from .solvers.basic import CG, CGLS, cg, cgls, clear_fused_cache
 from .solvers.sparsity import ISTA, FISTA, ista, fista
 from .solvers.segmented import cg_segmented, cgls_segmented

@@ -368,6 +368,27 @@ def _n_dev(op) -> int:
 # registry binds lazily by class object at first `estimate` call
 # instead, via the _builtin table of dotted names).
 
+def _cost_sparse_matmul(op, direction: str) -> OpCost:
+    """Sparse matmul tier: flops and matrix bytes scale with ``nnz``
+    (value + two int32 indices per triplet), not ``N·M`` — the whole
+    point of the tier. Adjoint charges the scatter's cross-shard
+    combine (psum-shaped, same bytes as the ring schedule's P-1 hops
+    of the x-block ring)."""
+    P = _n_dev(op)
+    it_v = _itemsize(op.dtype)
+    it_w = _itemsize(getattr(op, "compute_dtype", None) or op.dtype)
+    ff = _flop_factor(op.dtype)
+    flops = 2.0 * ff * op.nnz / P
+    trip = op.nnz * (it_w + 8.0) / P
+    if direction == "forward":
+        vec = (op.Ncol + op.N / P) * it_v
+        return OpCost(flops, trip + vec, 0.0, ("sparse.forward",))
+    vec = (op.N + op.Ncol / P) * it_v
+    ici = op.Ncol * it_v * 2.0 * (P - 1) / P
+    return OpCost(flops, trip + vec, ici,
+                  (f"sparse.adjoint+{op.adjoint_mode}",))
+
+
 def _cost_block_matmul(op, direction: str) -> OpCost:
     P = _n_dev(op)
     it_a = _itemsize(getattr(op, "compute_dtype", None) or op.dtype)
@@ -558,6 +579,8 @@ _BUILTIN = [
      _cost_block_matmul),
     ("pylops_mpi_tpu.ops.matrixmult:_MPISummaMatrixMult",
      _cost_summa_matmul),
+    ("pylops_mpi_tpu.ops.sparse:MPISparseMatrixMult",
+     _cost_sparse_matmul),
     ("pylops_mpi_tpu.ops.blockdiag:MPIBlockDiag", _cost_blockdiag),
     ("pylops_mpi_tpu.ops.stack:MPIVStack", _cost_stack),
     ("pylops_mpi_tpu.ops.stack:MPIHStack", _cost_stack),

@@ -9,3 +9,6 @@ from .nonstatconv import MPINonStationaryConvolve1D
 from .fft import MPIFFTND, MPIFFT2D
 from .fredholm import MPIFredholm1
 from .mdc import MPIMDC
+from .precond import (JacobiPrecond, BlockJacobiPrecond, VCyclePrecond,
+                      make_precond, probe_diagonal)
+from .sparse import MPISparseMatrixMult, auto_sparse_matmult

@@ -213,6 +213,27 @@ class MPIBlockDiag(MPILinearOperator):
     def _rmatvec(self, x: DistributedArray) -> DistributedArray:
         return self._apply(x, forward=False)
 
+    def diagonal(self) -> jnp.ndarray:
+        """Concatenated main diagonals of the blocks — the Jacobi
+        preconditioner's fast path (``ops/precond.probe_diagonal``
+        resolves this before probing). Batched blocks read the stacked
+        ``(nblk, m, n)`` array; heterogeneous stacks fall back to
+        per-block ``jnp.diagonal`` of the local matrices."""
+        if self._batched is not None and self._batched_k == 1:
+            B = self._batched
+            m = min(int(B.shape[1]), int(B.shape[2]))
+            d = B[:, jnp.arange(m), jnp.arange(m)]
+            return d.reshape(-1).astype(self.dtype)
+        parts = []
+        for op in self.ops:
+            A = getattr(op, "A", None)
+            if A is None:
+                raise AttributeError(
+                    "diagonal() needs matrix blocks (op.A); got "
+                    f"{type(op).__name__}")
+            parts.append(jnp.diagonal(jnp.asarray(A)))
+        return jnp.concatenate(parts).astype(self.dtype)
+
     def _ffi_normal_usable(self) -> bool:
         # CPU backends run the native one-pass XLA-FFI kernel
         # (native/ffi.py) — Pallas-interpret would be a perf trap

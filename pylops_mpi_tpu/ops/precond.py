@@ -1,0 +1,497 @@
+"""Preconditioners for the fused Krylov solvers (docs/preconditioning.md).
+
+Three SPD approximate inverses, each an :class:`MPILinearOperator` so
+the solver seam (``cg(..., M=...)`` / ``cgls(..., M=...)`` and the
+block/segmented variants) treats them like any other operator — the
+apply traces INTO the fused ``lax.while_loop``:
+
+- :class:`JacobiPrecond` — ``M = diag(A)⁻¹``. The diagonal comes from
+  an operator's own ``diagonal()`` method when it has one (the fast
+  path: MPIBlockDiag and MPISparseMatrixMult know theirs), from
+  lattice probing for stencil operators (``probe_diagonal`` with a
+  stride/dims hint — ``(2·reach+1)^ndim`` matvecs regardless of n), or
+  from exact basis probing for small operators.
+- :class:`BlockJacobiPrecond` — per-block dense Cholesky factors,
+  solved in one batched ``cho_solve``. The factorization happens ONCE
+  at construction (host/eager); the apply is a reshape + batched
+  triangular solve with zero collectives — each block's solve touches
+  only rows the owning shard already holds when the block size divides
+  the shard size.
+- :class:`VCyclePrecond` — geometric multigrid: one V-cycle with a
+  weighted-Jacobi smoother (``ω = 2/3``), factor-2
+  restriction/prolongation per grid dim (averaging / injection — an
+  adjoint pair up to a positive scalar, so the cycle stays SPD), the
+  level operators re-discretized through a user factory on the
+  coarsened dims, and a dense Cholesky solve on the coarsest grid
+  (probed + factored at construction). Level count resolves against
+  ``PYLOPS_MPI_TPU_MG_LEVELS``.
+
+All three accept block ``(n, K)`` vectors — K columns preconditioned
+in one apply (``accepts_block``), which is what keeps the block
+solvers' per-column freeze masks intact. Applies are pure jnp on the
+logical global vector (layout round-trips via the owning array's
+``_from_global``), so they fuse into the solver program with no host
+callbacks. Preconditioners are closed over by the compiled solver (not
+passed as pytree arguments), so multi-process meshes need
+operator-registered classes; the CPU sim and single-process TPU paths
+used by the solvers today are unaffected.
+
+``make_precond`` dispatches on the ``PYLOPS_MPI_TPU_PRECOND`` knob so
+harnesses (CI's ``test-precond`` leg, bench) can flip a family of
+solves to a preconditioner without touching call sites.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import jax.scipy.linalg as jsla
+
+from ..distributedarray import DistributedArray
+from ..linearoperator import MPILinearOperator
+
+__all__ = ["JacobiPrecond", "BlockJacobiPrecond", "VCyclePrecond",
+           "probe_diagonal", "make_precond"]
+
+
+# ------------------------------------------------------------- probing
+def probe_diagonal(Op, *, dims: Optional[Tuple[int, ...]] = None,
+                   reach: int = 1, stride: Optional[int] = None,
+                   nmax: int = 2048) -> jnp.ndarray:
+    """Extract (or estimate) ``diag(Op)`` with O(1) matvecs.
+
+    Resolution order:
+
+    1. ``Op.diagonal()`` when the operator knows its own diagonal —
+       exact, zero matvecs.
+    2. ``dims`` given: lattice probing on the ``dims`` grid with
+       per-dim stride ``2*reach + 1`` — ``(2*reach+1)^ndim`` matvecs,
+       EXACT for stencils whose per-dim reach is ``<= reach`` (the
+       derivative/Laplacian operators), because no two probed sites
+       within one indicator vector interact.
+    3. ``stride`` given: the 1-D lattice special case (banded
+       operators with bandwidth ``< stride``).
+    4. Fallback: ``n`` basis probes — exact for anything, but O(n)
+       matvecs, so refused above ``nmax`` (tests/small operators).
+    """
+    diag_fn = getattr(Op, "diagonal", None)
+    if callable(diag_fn):
+        return jnp.asarray(diag_fn())
+    n = int(Op.shape[1])
+    dt = np.dtype(Op.dtype) if Op.dtype is not None else np.float64
+
+    def apply(e: np.ndarray) -> np.ndarray:
+        v = Op.matvec(DistributedArray.to_dist(
+            jnp.asarray(e), mesh=getattr(Op, "mesh", None)))
+        return np.asarray(v.asarray())
+
+    if dims is not None:
+        dims = tuple(int(d) for d in dims)
+        if int(np.prod(dims)) != n:
+            raise ValueError(f"dims {dims} do not flatten to n={n}")
+        s = 2 * int(reach) + 1
+        d = np.zeros(n, dtype=dt)
+        grid = np.indices(dims)
+        flat_ix = np.arange(n).reshape(dims)
+        for offs in itertools.product(*(range(min(s, dd)) for dd in dims)):
+            sel = np.ones(dims, dtype=bool)
+            for ax, o in enumerate(offs):
+                sel &= (grid[ax] % s) == o
+            e = np.zeros(n, dtype=dt)
+            e[flat_ix[sel]] = 1
+            d[flat_ix[sel]] = apply(e)[flat_ix[sel]]
+        return jnp.asarray(d)
+    if stride is not None:
+        s = int(stride)
+        d = np.zeros(n, dtype=dt)
+        for o in range(min(s, n)):
+            e = np.zeros(n, dtype=dt)
+            e[o::s] = 1
+            d[o::s] = apply(e)[o::s]
+        return jnp.asarray(d)
+    if n > nmax:
+        raise ValueError(
+            f"probe_diagonal would need {n} matvecs (> nmax={nmax}); "
+            "pass dims=/stride= for lattice probing, or give the "
+            "operator a diagonal() method")
+    d = np.zeros(n, dtype=dt)
+    for j in range(n):
+        e = np.zeros(n, dtype=dt)
+        e[j] = 1
+        d[j] = apply(e)[j]
+    return jnp.asarray(d)
+
+
+def _chk(arr) -> str:
+    """Cheap content checksum for precond signatures — stable across
+    processes (unlike ``id``), so checkpoint-resume can tell two
+    different preconditioners of the same shape apart."""
+    a = np.asarray(jax.device_get(arr), dtype=np.float64)
+    return f"{float(np.nansum(np.abs(a))):.6e}"
+
+
+def _wrap_like(g: jnp.ndarray, x: DistributedArray) -> DistributedArray:
+    """Logical global result → DistributedArray on ``x``'s exact
+    layout (jit-safe: ``_from_global`` is a static-index take)."""
+    return DistributedArray._wrap(x._from_global(g), x)
+
+
+# -------------------------------------------------------------- Jacobi
+class JacobiPrecond(MPILinearOperator):
+    """Diagonal (Jacobi) preconditioner: ``M x = x / diag``.
+
+    ``diag`` entries with magnitude below ``tiny`` pass through
+    unscaled (a zero diagonal must not poison the solve with inf).
+    Self-adjoint by construction (real SPD operators have a real
+    positive diagonal; complex diagonals use the conjugate on the
+    adjoint apply).
+    """
+
+    accepts_block = True
+
+    def __init__(self, diag, mesh=None, dtype=None,
+                 tiny: float = 1e-30):
+        d = jnp.asarray(diag, dtype=dtype)
+        n = int(d.shape[0])
+        self.mesh = mesh
+        self._dinv = jnp.where(jnp.abs(d) > tiny, 1.0 / d,
+                               jnp.ones_like(d))
+        super().__init__(shape=(n, n), dtype=d.dtype)
+        self._sig = f"jacobi[{n},{np.dtype(self.dtype)},{_chk(d)}]"
+
+    @classmethod
+    def from_operator(cls, Op, **probe_kw) -> "JacobiPrecond":
+        return cls(probe_diagonal(Op, **probe_kw),
+                   mesh=getattr(Op, "mesh", None), dtype=Op.dtype)
+
+    def precond_signature(self) -> str:
+        return self._sig
+
+    def _apply(self, x: DistributedArray, d: jnp.ndarray):
+        g = x._global()
+        d = d.astype(g.dtype)
+        if g.ndim == 2:
+            d = d[:, None]
+        return _wrap_like(g * d, x)
+
+    def _matvec(self, x):
+        return self._apply(x, self._dinv)
+
+    def _rmatvec(self, x):
+        return self._apply(x, jnp.conj(self._dinv))
+
+
+# -------------------------------------------------------- block-Jacobi
+class BlockJacobiPrecond(MPILinearOperator):
+    """Block-Jacobi preconditioner: ``nblk`` dense ``m×m`` diagonal
+    blocks, Cholesky-factored once at construction and applied as one
+    batched ``cho_solve`` — a reshape plus ``nblk`` independent
+    triangular solves, no collectives (each block's rows live on one
+    shard whenever ``m`` divides the shard size).
+
+    ``blocks`` is the stacked ``(nblk, m, m)`` array. Blocks are
+    symmetrized and ridge-shifted (``ridge="auto"`` adds
+    ``1e-6 · mean|diag|``) before factorization so probed
+    approximations that picked up off-block mass still factor.
+    """
+
+    accepts_block = True
+
+    def __init__(self, blocks, mesh=None, dtype=None, ridge="auto"):
+        B = jnp.asarray(blocks, dtype=dtype)
+        if B.ndim != 3 or B.shape[1] != B.shape[2]:
+            raise ValueError(
+                f"blocks must be (nblk, m, m), got {B.shape}")
+        nblk, m, _ = B.shape
+        B = 0.5 * (B + jnp.conj(jnp.swapaxes(B, 1, 2)))
+        if ridge == "auto":
+            ridge = 1e-6 * float(jnp.mean(jnp.abs(
+                jnp.diagonal(B, axis1=1, axis2=2))))
+        if ridge:
+            B = B + ridge * jnp.eye(m, dtype=B.dtype)
+        self.mesh = mesh
+        self.nblk, self.m = int(nblk), int(m)
+        # eager batched factorization — the one-off setup cost the
+        # per-iteration triangular solves amortize. A batched Cholesky
+        # of an indefinite block yields silent NaN rows, not an
+        # exception: probed approximations of stencil operators alias
+        # cross-block couplings into the diagonal block and can land
+        # genuinely indefinite, past any fixed ridge. Those blocks get
+        # an SPD eigenvalue clamp (a preconditioner only needs a
+        # nearby SPD apply, not the exact probe).
+        chol = jax.vmap(lambda b: jsla.cho_factor(b, lower=True)[0])(B)
+        bad = ~jnp.all(jnp.isfinite(chol), axis=(1, 2))
+        if bool(jnp.any(bad)):
+            Bn = np.array(B)   # copy — np.asarray of a jax array is read-only
+            for i in np.nonzero(np.asarray(bad))[0]:
+                w, v = np.linalg.eigh(Bn[i])
+                floor = 1e-6 * max(float(np.max(np.abs(w))), 1e-30)
+                Bn[i] = (v * np.maximum(w, floor)) @ v.conj().T
+            B = jnp.asarray(Bn)
+            chol = jax.vmap(
+                lambda b: jsla.cho_factor(b, lower=True)[0])(B)
+        self._chol = chol
+        n = self.nblk * self.m
+        super().__init__(shape=(n, n), dtype=B.dtype)
+        self._sig = (f"block_jacobi[{nblk}x{m},{np.dtype(self.dtype)},"
+                     f"{_chk(jnp.diagonal(B, axis1=1, axis2=2))}]")
+
+    @classmethod
+    def from_operator(cls, Op, block_size: int, *, normal: bool = False,
+                      damp: float = 0.0, **kw) -> "BlockJacobiPrecond":
+        """Probe ``Op`` (or its normal operator ``OpᴴOp + damp²`` when
+        ``normal=True`` — the CGLS seam) with ``block_size`` lattice
+        indicators: probe ``j`` lights every index ``≡ j (mod m)``, so
+        one matvec yields column ``j`` of EVERY diagonal block — exact
+        for block-diagonal operators, a block-lumped approximation
+        otherwise. ``m`` matvecs total, independent of ``n``."""
+        n = int(Op.shape[1])
+        m = int(block_size)
+        if n % m:
+            raise ValueError(f"block_size {m} does not divide n={n}")
+        nblk = n // m
+        dt = np.dtype(Op.dtype) if Op.dtype is not None else np.float64
+        damp2 = damp ** 2
+        cols = np.zeros((nblk, m, m), dtype=dt)
+        mesh = getattr(Op, "mesh", None)
+        for j in range(m):
+            e = np.zeros(n, dtype=dt)
+            e[j::m] = 1
+            ed = DistributedArray.to_dist(jnp.asarray(e), mesh=mesh)
+            if normal:
+                q = Op.rmatvec(Op.matvec(ed))
+                qv = np.asarray(q.asarray()) + damp2 * e
+            else:
+                qv = np.asarray(Op.matvec(ed).asarray())
+            cols[:, :, j] = qv.reshape(nblk, m)
+        return cls(cols, mesh=mesh, dtype=dt, **kw)
+
+    @classmethod
+    def from_block_diag(cls, Op, *, normal: bool = False,
+                        damp: float = 0.0, **kw) -> "BlockJacobiPrecond":
+        """Fast path for :class:`~pylops_mpi_tpu.ops.blockdiag.MPIBlockDiag`
+        with homogeneous batched blocks: the stacked ``(nblk, m, n)``
+        GEMM tensor is already the exact block list — zero probes.
+        ``normal=True`` builds ``AᵢᴴAᵢ + damp²`` per block (the CGLS
+        normal-system blocks, square even when the blocks are not)."""
+        batched = getattr(Op, "_batched", None)
+        if batched is None:
+            raise ValueError(
+                "from_block_diag needs an MPIBlockDiag with a batched "
+                "homogeneous block stack; use from_operator instead")
+        B = jnp.asarray(batched, dtype=Op.dtype)
+        if normal:
+            Bh = jnp.conj(jnp.swapaxes(B, 1, 2))
+            G = jnp.einsum("bij,bjk->bik", Bh, B)
+            if damp:
+                G = G + (damp ** 2) * jnp.eye(G.shape[1], dtype=G.dtype)
+            return cls(G, mesh=getattr(Op, "mesh", None),
+                       dtype=Op.dtype, **kw)
+        if B.shape[1] != B.shape[2]:
+            raise ValueError(
+                f"blocks are {B.shape[1]}x{B.shape[2]} (not square); "
+                "only the normal=True form is SPD-invertible")
+        return cls(B, mesh=getattr(Op, "mesh", None), dtype=Op.dtype,
+                   **kw)
+
+    def precond_signature(self) -> str:
+        return self._sig
+
+    def _solve(self, g: jnp.ndarray) -> jnp.ndarray:
+        cdt = self._chol.dtype
+        if g.ndim == 2:
+            K = g.shape[1]
+            rb = g.reshape(self.nblk, self.m, K).astype(cdt)
+        else:
+            rb = g.reshape(self.nblk, self.m, 1).astype(cdt)
+        sol = jax.vmap(lambda c, b: jsla.cho_solve((c, True), b))(
+            self._chol, rb)
+        out = sol.reshape(self.shape[1], -1) if g.ndim == 2 \
+            else sol.reshape(self.shape[1])
+        return out.astype(g.dtype)
+
+    def _matvec(self, x):
+        return _wrap_like(self._solve(x._global()), x)
+
+    _rmatvec = _matvec  # symmetric (real SPD blocks after symmetrize)
+
+
+# ------------------------------------------------------------- V-cycle
+def _restrict(g: jnp.ndarray, dims: Tuple[int, ...]) -> jnp.ndarray:
+    """Factor-2 averaging restriction per grid dim (cell-centered):
+    each coarse cell is the mean of its 2 children along every axis."""
+    t = g.reshape(dims)
+    for ax in range(len(dims)):
+        ev = jnp.take(t, jnp.arange(0, t.shape[ax], 2), axis=ax)
+        od = jnp.take(t, jnp.arange(1, t.shape[ax], 2), axis=ax)
+        t = 0.5 * (ev + od)
+    return t.reshape(-1)
+
+
+def _prolong(gc: jnp.ndarray, dims_c: Tuple[int, ...]) -> jnp.ndarray:
+    """Piecewise-constant injection (the restriction's adjoint up to
+    the 2^ndim averaging factor, which keeps the V-cycle symmetric up
+    to a positive scalar — PCG-safe)."""
+    t = gc.reshape(dims_c)
+    for ax in range(len(dims_c)):
+        t = jnp.repeat(t, 2, axis=ax)
+    return t.reshape(-1)
+
+
+class VCyclePrecond(MPILinearOperator):
+    """Geometric multigrid V-cycle preconditioner.
+
+    ``op_factory(dims)`` must return the operator discretized on the
+    ``dims`` grid (shape ``(prod(dims), prod(dims))``) — each level is
+    re-discretized rather than Galerkin-projected, which is what the
+    existing derivative/Laplacian factories give for free. Per level
+    the constructor probes the diagonal (``probe_diagonal`` lattice
+    probing, exact for ``reach``-limited stencils) for the weighted
+    Jacobi smoother; the coarsest level is densified (``todense`` —
+    kept small by ``levels``/divisibility) and Cholesky-factored once.
+
+    One apply = one V-cycle with ``nu_pre``/``nu_post`` smoothing
+    sweeps, recursion unrolled at trace time, everything pure jnp —
+    the whole cycle fuses into the solver loop.
+    """
+
+    accepts_block = True
+
+    def __init__(self, op_factory: Callable, dims: Sequence[int], *,
+                 levels: Optional[int] = None, nu_pre: int = 1,
+                 nu_post: int = 1, omega: float = 2.0 / 3.0,
+                 reach: int = 1, coarsest_max: int = 4096,
+                 mesh=None, dtype=None):
+        from ..utils.deps import mg_levels_default
+        dims = tuple(int(d) for d in dims)
+        if levels is None:
+            levels = mg_levels_default()
+        self.omega = float(omega)
+        self.nu_pre, self.nu_post = int(nu_pre), int(nu_post)
+        self.mesh = mesh
+        # coarsen by 2 per dim while every dim stays even and > 2;
+        # auto-reduce the level count when divisibility runs out
+        level_dims = [dims]
+        while (len(level_dims) < levels
+               and all(d % 2 == 0 and d > 2 for d in level_dims[-1])):
+            level_dims.append(tuple(d // 2 for d in level_dims[-1]))
+        self.level_dims = level_dims
+        self._ops, self._dinv, self._tmpl = [], [], []
+        for dl in level_dims:
+            op = op_factory(dl)
+            nl = int(np.prod(dl))
+            if op.shape != (nl, nl):
+                raise ValueError(
+                    f"op_factory({dl}) returned shape {op.shape}, "
+                    f"expected {(nl, nl)}")
+            d = probe_diagonal(op, dims=dl, reach=reach)
+            self._ops.append(op)
+            self._dinv.append(jnp.where(jnp.abs(d) > 1e-30, 1.0 / d,
+                                        jnp.ones_like(d)))
+            self._tmpl.append(DistributedArray(
+                global_shape=nl, mesh=mesh, dtype=op.dtype))
+        nc = int(np.prod(level_dims[-1]))
+        if nc > coarsest_max:
+            raise ValueError(
+                f"coarsest grid {level_dims[-1]} has {nc} unknowns "
+                f"(> coarsest_max={coarsest_max}); raise levels or "
+                "coarsest_max")
+        Ac = np.asarray(self._ops[-1].todense())
+        Ac = 0.5 * (Ac + Ac.conj().T)
+        Ac += 1e-12 * np.trace(np.abs(Ac)) / nc * np.eye(nc)
+        try:
+            self._chol_c = jnp.asarray(np.linalg.cholesky(Ac))
+            self._inv_c = None
+        except np.linalg.LinAlgError:
+            # boundary discretizations can leave the symmetrized
+            # coarse matrix slightly indefinite; a dense (pseudo)
+            # inverse is a fine coarse SOLVE for a preconditioner and
+            # applies as one small GEMM inside the fused loop
+            self._chol_c = None
+            self._inv_c = jnp.asarray(np.linalg.pinv(Ac))
+        n = int(np.prod(dims))
+        dt = dtype if dtype is not None else self._ops[0].dtype
+        super().__init__(shape=(n, n), dtype=dt)
+        self._sig = (f"mg[{'x'.join(map(str, dims))},"
+                     f"L={len(level_dims)},nu={nu_pre}/{nu_post},"
+                     f"w={self.omega:.3f},{np.dtype(self.dtype)}]")
+
+    def precond_signature(self) -> str:
+        return self._sig
+
+    def _level_apply(self, l: int, g: jnp.ndarray) -> jnp.ndarray:
+        tmpl = self._tmpl[l]
+        v = DistributedArray._wrap(tmpl._from_global(g), tmpl)
+        return self._ops[l].matvec(v)._global()
+
+    def _cycle(self, l: int, b: jnp.ndarray) -> jnp.ndarray:
+        if l == len(self.level_dims) - 1:
+            if self._chol_c is not None:
+                c = self._chol_c.astype(b.dtype)
+                return jsla.cho_solve((c, True), b)
+            return (self._inv_c.astype(b.dtype) @ b)
+        dinv = self._dinv[l].astype(b.dtype)
+        om = jnp.asarray(self.omega, dtype=b.dtype)
+        x = om * dinv * b  # first sweep from x=0
+        for _ in range(self.nu_pre - 1):
+            x = x + om * dinv * (b - self._level_apply(l, x))
+        r = b - self._level_apply(l, x)
+        xc = self._cycle(l + 1, _restrict(r, self.level_dims[l]))
+        x = x + _prolong(xc, self.level_dims[l + 1]).astype(b.dtype)
+        for _ in range(self.nu_post):
+            x = x + om * dinv * (b - self._level_apply(l, x))
+        return x
+
+    def _matvec(self, x):
+        g = x._global()
+        wdt = np.promote_types(g.dtype, np.dtype(self.dtype))
+        if g.ndim == 2:
+            out = jax.vmap(lambda col: self._cycle(0, col.astype(wdt)),
+                           in_axes=1, out_axes=1)(g)
+        else:
+            out = self._cycle(0, g.astype(wdt))
+        return _wrap_like(out.astype(g.dtype), x)
+
+    _rmatvec = _matvec  # symmetric cycle (see _prolong)
+
+
+# ----------------------------------------------------------- dispatch
+def make_precond(Op, kind: Optional[str] = None, **kw):
+    """Build a preconditioner for ``Op`` by name, defaulting to the
+    ``PYLOPS_MPI_TPU_PRECOND`` knob: ``none`` → ``None`` (the solvers'
+    bit-identical unpreconditioned path), ``jacobi`` →
+    :meth:`JacobiPrecond.from_operator`, ``block_jacobi`` →
+    :meth:`BlockJacobiPrecond.from_operator` (``block_size`` required
+    unless ``Op`` is an MPIBlockDiag with a batched stack), ``mg`` →
+    :class:`VCyclePrecond` (requires ``op_factory`` and ``dims``)."""
+    from ..utils.deps import precond_default
+    if kind is None:
+        kind = precond_default()
+    kind = str(kind).lower()
+    if kind in ("none", "", "off", "0"):
+        return None
+    if kind == "jacobi":
+        return JacobiPrecond.from_operator(Op, **kw)
+    if kind == "block_jacobi":
+        if "block_size" not in kw and getattr(Op, "_batched", None) \
+                is not None:
+            return BlockJacobiPrecond.from_block_diag(Op, **kw)
+        if "block_size" not in kw:
+            raise ValueError(
+                "block_jacobi needs block_size= (or an MPIBlockDiag "
+                "with a batched homogeneous stack)")
+        return BlockJacobiPrecond.from_operator(Op, **kw)
+    if kind == "mg":
+        factory = kw.pop("op_factory", None)
+        dims = kw.pop("dims", None)
+        if factory is None or dims is None:
+            raise ValueError("mg needs op_factory= and dims=")
+        return VCyclePrecond(factory, dims,
+                             mesh=getattr(Op, "mesh", None), **kw)
+    raise ValueError(
+        f"unknown preconditioner kind {kind!r}; expected none, jacobi, "
+        "block_jacobi or mg")

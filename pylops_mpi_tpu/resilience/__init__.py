@@ -39,7 +39,7 @@ from .status import (RUNNING, CONVERGED, MAXITER, BREAKDOWN, STAGNATION,
                      status_name, guards_mode, guards_enabled,
                      last_status)
 from .retry import retry_call
-from .driver import resilient_solve, ResilientResult
+from .driver import (resilient_solve, refined_solve, ResilientResult, RefinedResult)
 from .elastic import (WatchdogTimeout, watched_call, watchdog_mode,
                       watchdog_enabled, start_heartbeat, stop_heartbeat,
                       maybe_start_heartbeat, worker_config,
@@ -51,7 +51,7 @@ from .supervisor import launch_job, JobResult, Failure, WorkerHandle
 __all__ = ["elastic", "faults", "retry", "status", "supervisor",
            "RUNNING", "CONVERGED", "MAXITER", "BREAKDOWN", "STAGNATION",
            "status_name", "guards_mode", "guards_enabled", "last_status",
-           "retry_call", "resilient_solve", "ResilientResult",
+           "retry_call", "resilient_solve", "refined_solve", "ResilientResult", "RefinedResult",
            "WatchdogTimeout", "watched_call", "watchdog_mode",
            "watchdog_enabled", "start_heartbeat", "stop_heartbeat",
            "maybe_start_heartbeat", "worker_config",

@@ -95,6 +95,12 @@ class FamilySpec:
     tol: float = 0.0
     damp: float = 0.0
     dtype: object = np.float32
+    # optional preconditioner (ops/precond.py) threaded into the block
+    # solvers; part of the family identity — the fused cache keys on
+    # id(M), so every bucket of the family reuses one compiled PCG/
+    # PCGLS program, and M=None families lower bit-identically to the
+    # pre-preconditioner engine
+    M: object = None
 
     def __post_init__(self):
         if self.solver not in ("cg", "cgls"):
@@ -210,12 +216,13 @@ class WarmPool:
                                      solver=spec.solver):
             if spec.solver == "cg":
                 xb, iiter, cost = block_cg(
-                    spec.operator, yb, niter=spec.niter, tol=spec.tol)
+                    spec.operator, yb, niter=spec.niter, tol=spec.tol,
+                    M=spec.M)
                 kold = np.asarray(cost)[-1] ** 2
             else:
                 xb, _istop, iiter, kold, _r2, _cost = block_cgls(
                     spec.operator, yb, niter=spec.niter,
-                    damp=spec.damp, tol=spec.tol)
+                    damp=spec.damp, tol=spec.tol, M=spec.M)
         wall = time.perf_counter() - t0
         self.warmed.add((name, bucket))
         _metrics.inc("serve.pool.solves")

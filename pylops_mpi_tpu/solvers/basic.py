@@ -79,6 +79,51 @@ def _step_scalar(s, carry_dtype):
     return s.astype(dt)
 
 
+def _cast_vec(v, dt):
+    """Cast a (possibly stacked) distributed vector to ``dt`` without
+    leaving the jit trace — used to pin a preconditioner's output back
+    to the carry dtype so the while_loop pytree dtypes stay fixed."""
+    if isinstance(v, StackedDistributedArray):
+        return StackedDistributedArray(
+            [_cast_vec(d, dt) for d in v.distarrays])
+    return DistributedArray._wrap(v._arr.astype(dt), v)
+
+
+def _precond_apply(M, r, xdt):
+    """Apply the preconditioner seam: ``z = M⁻¹ r`` (``M.matvec`` — the
+    preconditioner operator IS the approximate inverse), cast back to
+    the carry dtype. ``M=None`` returns ``r`` ITSELF — not a copy, not
+    a new op — so the unpreconditioned trace is the literally unchanged
+    pre-seam program (the ``M=None`` HLO bit-identity pin,
+    tests/test_precond.py)."""
+    if M is None:
+        return r
+    z = M.matvec(r)
+    if np.dtype(_vdtype(z)) != np.dtype(xdt):
+        z = _cast_vec(z, np.dtype(xdt))
+    return z
+
+
+def _precond_signature(M) -> str:
+    """Stable identity of a preconditioner CONFIGURATION (not instance)
+    — what segmented checkpoints bank so a resume with a different M
+    refuses instead of silently mixing trajectories."""
+    if M is None:
+        return "none"
+    sig = getattr(M, "precond_signature", None)
+    if callable(sig):
+        return str(sig())
+    return f"{type(M).__name__}{tuple(M.shape)}"
+
+
+def _mkey(M):
+    """Fused-cache key component for the preconditioner: EMPTY when
+    ``M=None`` so every pre-seam cache key is byte-identical to before
+    the seam existed (zero new cache entries for unpreconditioned
+    solves)."""
+    return () if M is None else (("M", id(M)),)
+
+
 def _mp_floor(k0):
     """Machine-precision floor for the solver's squared recurrence
     norm — ``k = |r|²`` for CG, ``k = |Aᴴr|²`` for CGLS: once ``k``
@@ -360,14 +405,21 @@ def _fault_sites(guards: bool, fault):
     return None, None
 
 
-def _make_cg_body(Op, xdt, floors, *, guards=False, carry_status=False,
-                  stall_n=0, fault=None):
+def _make_cg_body(Op, xdt, floors, *, M=None, guards=False,
+                  carry_status=False, stall_n=0, fault=None):
     """CG loop body over the carry ``(x, r, c, kold, iiter, cost
     [, status][, bestk, stall])`` — the one implementation behind the
     single-shot fused loop, the guarded variant and the segmented
     epoch program. ``carry_status`` threads the status word without
     the detectors (the segmented path always carries it so resumed
-    epochs keep one pytree)."""
+    epochs keep one pytree).
+
+    ``M`` is the preconditioner seam (PCG): ``z = M r`` replaces ``r``
+    in the recurrence norm (``kold = r·z``) and the direction update
+    (``c = z + b c``) — the TRUE residual ``r`` stays in the carry, so
+    the carry pytree (shapes, dtypes, donation aliasing) is identical
+    with and without M, and ``M=None`` traces the exact
+    unpreconditioned program (``z`` IS ``r``)."""
     from ..resilience import faults as _faults
     nan_at, stall_at = _fault_sites(guards, fault)
 
@@ -388,10 +440,11 @@ def _make_cg_body(Op, xdt, floors, *, guards=False, carry_status=False,
             a = _faults.inject_stall(a, iiter, stall_at)
         xn = x + c * _step_scalar(a, xdt)
         rn = r - Opc * _step_scalar(a, xdt)
-        k = _rdot(rn, rn)
+        zn = _precond_apply(M, rn, xdt)
+        k = _rdot(rn, zn)
         k = jnp.where(done, kold, k)
         b = jnp.where(done, jnp.zeros_like(k), k / kold)
-        cn = rn + c * _step_scalar(b, xdt)
+        cn = zn + c * _step_scalar(b, xdt)
         if guards:
             bad = (jnp.any(~jnp.isfinite(a)) | jnp.any(~jnp.isfinite(k))
                    | jnp.any(~jnp.isfinite(b)))
@@ -417,7 +470,7 @@ def _make_cg_body(Op, xdt, floors, *, guards=False, carry_status=False,
     return body
 
 
-def _cg_fused(Op, y: Vector, x0: Vector, tol, *, niter: int,
+def _cg_fused(Op, y: Vector, x0: Vector, tol, *, niter: int, M=None,
               guards: bool = False, stall_n: int = 0, fault=None):
     """Whole CG solve as one ``lax.while_loop`` (SURVEY §3.2: the
     reference's hot loop does 4 host-synced allreduces per iteration —
@@ -425,17 +478,19 @@ def _cg_fused(Op, y: Vector, x0: Vector, tol, *, niter: int,
     scalars accumulate at the policy reduction dtype (``_rdot``) and
     re-enter vector updates at the carry dtype (``_step_scalar``) so
     the carry pytree dtypes are identical at iteration 1 and k.
+    ``M`` preconditions (PCG — see :func:`_make_cg_body`);
     ``guards=True`` returns an extra status word (see the section
     comment above)."""
     xdt = _vdtype(x0)
     x = x0  # donated: the carry aliases the caller's buffer in place
     r = y - Op.matvec(x)
-    c = r
-    kold = _rdot(r, r)
+    z = _precond_apply(M, r, xdt)
+    c = z
+    kold = _rdot(r, z)
     floors = _mp_floor(kold)
     cost0 = jnp.zeros((niter + 1,) + jnp.shape(kold), dtype=jnp.asarray(kold).dtype)
     cost0 = lax.dynamic_update_index_in_dim(cost0, jnp.sqrt(kold), 0, 0)
-    body = _make_cg_body(Op, xdt, floors, guards=guards,
+    body = _make_cg_body(Op, xdt, floors, M=M, guards=guards,
                          stall_n=stall_n, fault=fault)
     if guards:
         from ..resilience import status as _rstatus
@@ -459,13 +514,16 @@ def _cg_fused(Op, y: Vector, x0: Vector, tol, *, niter: int,
     return x, iiter, cost
 
 
-def _make_cgls_body(Op, xdt, damp2, floors, *, normal=False,
+def _make_cgls_body(Op, xdt, damp2, floors, *, M=None, normal=False,
                     guards=False, carry_status=False, stall_n=0,
                     fault=None):
     """CGLS loop body (classic two-sweep or fused-normal) over the
     carry ``(x, s, c, q, ...)`` / ``(x, s, r, c, ...)`` — shared by the
     single-shot loops, the guarded variants and the segmented epoch
-    program (solvers/segmented.py)."""
+    program (solvers/segmented.py). ``M`` preconditions the NORMAL
+    equations (PCGLS): it should approximate ``(OpᴴOp + damp²)⁻¹``;
+    applied to the normal residual in both sweep schedules, carries
+    unchanged, ``M=None`` bit-identical (see :func:`_make_cg_body`)."""
     from ..resilience import faults as _faults
     nan_at, stall_at = _fault_sites(guards, fault)
 
@@ -485,10 +543,11 @@ def _make_cgls_body(Op, xdt, damp2, floors, *, normal=False,
         xn = x + c * _step_scalar(a, xdt)
         sn_ = s - q * _step_scalar(a, xdt)
         r = Op.rmatvec(sn_) - xn * damp2
-        k = _rdot(r, r)
+        z = _precond_apply(M, r, xdt)
+        k = _rdot(r, z)
         k = jnp.where(done, kold, k)
         b = jnp.where(done, jnp.zeros_like(k), k / kold)
-        cn = r + c * _step_scalar(b, xdt)
+        cn = z + c * _step_scalar(b, xdt)
         qn = Op.matvec(cn)
         if nan_at is not None:
             qn = _faults.inject_nan(qn, iiter, nan_at)
@@ -538,10 +597,11 @@ def _make_cgls_body(Op, xdt, damp2, floors, *, normal=False,
         xn = x + c * _step_scalar(a, xdt)
         sn_ = s - q * _step_scalar(a, xdt)
         rn = r - (u + c * damp2) * _step_scalar(a, xdt)
-        k = _rdot(rn, rn)
+        zn = _precond_apply(M, rn, xdt)
+        k = _rdot(rn, zn)
         k = jnp.where(done, kold, k)
         b = jnp.where(done, jnp.zeros_like(k), k / kold)
-        cn = rn + c * _step_scalar(b, xdt)
+        cn = zn + c * _step_scalar(b, xdt)
         if guards:
             bad = (jnp.any(~jnp.isfinite(a)) | jnp.any(~jnp.isfinite(k))
                    | jnp.any(~jnp.isfinite(b)))
@@ -572,7 +632,7 @@ def _make_cgls_body(Op, xdt, damp2, floors, *, normal=False,
 
 
 def _cgls_setup(Op, y: Vector, x0: Vector, damp, damp2, *, niter: int,
-                normal: bool):
+                normal: bool, M=None):
     """Shared CGLS prologue: residuals, first direction, recurrence
     norm, machine-precision floor and the cost buffers — used by the
     single-shot fused loops here and the segmented driver
@@ -580,10 +640,11 @@ def _cgls_setup(Op, y: Vector, x0: Vector, damp, damp2, *, niter: int,
     x = x0  # donated: carry aliases the caller's buffer (see _DONATE_X0)
     s = y - Op.matvec(x)
     rq = Op.rmatvec(s) - x * damp  # ref's un-squared setup damp (see
-    c = rq                         # module doc) seeds only the first
-    if not normal:                 # direction, as in the classic path
+    z = _precond_apply(M, rq, _vdtype(x0))  # module doc) seeds only
+    c = z                          # the first direction, as in the
+    if not normal:                 # classic path
         q = Op.matvec(c)
-    kold = _rdot(rq, rq)
+    kold = _rdot(rq, z)
     floors = _mp_floor(kold)
     if normal:
         # the recurrence tracks the true gradient r = Opᴴs − damp²x, so
@@ -601,13 +662,14 @@ def _cgls_setup(Op, y: Vector, x0: Vector, damp, damp2, *, niter: int,
 
 
 def _cgls_fused_any(Op, y: Vector, x0: Vector, damp, tol, *, niter: int,
-                    normal: bool, guards: bool, stall_n: int = 0,
+                    normal: bool, guards: bool, M=None, stall_n: int = 0,
                     fault=None):
     damp2 = damp ** 2
     xdt = _vdtype(x0)
     head, floors, cost0, cost1_0 = _cgls_setup(Op, y, x0, damp, damp2,
-                                               niter=niter, normal=normal)
-    body = _make_cgls_body(Op, xdt, damp2, floors, normal=normal,
+                                               niter=niter, normal=normal,
+                                               M=M)
+    body = _make_cgls_body(Op, xdt, damp2, floors, M=M, normal=normal,
                            guards=guards, stall_n=stall_n, fault=fault)
     if guards:
         from ..resilience import status as _rstatus
@@ -634,14 +696,15 @@ def _cgls_fused_any(Op, y: Vector, x0: Vector, damp, tol, *, niter: int,
 
 
 def _cgls_fused(Op, y: Vector, x0: Vector, damp, tol, *, niter: int,
-                guards: bool = False, stall_n: int = 0, fault=None):
+                guards: bool = False, M=None, stall_n: int = 0,
+                fault=None):
     return _cgls_fused_any(Op, y, x0, damp, tol, niter=niter,
-                           normal=False, guards=guards, stall_n=stall_n,
-                           fault=fault)
+                           normal=False, guards=guards, M=M,
+                           stall_n=stall_n, fault=fault)
 
 
 def _cgls_fused_normal(Op, y: Vector, x0: Vector, damp, tol, *,
-                       niter: int, guards: bool = False,
+                       niter: int, guards: bool = False, M=None,
                        stall_n: int = 0, fault=None):
     """CGLS with one operator memory sweep per iteration: the step uses
     ``(u, q) = Op.normal_matvec(c)`` (``u = OpᴴOp c`` computed in the
@@ -651,8 +714,8 @@ def _cgls_fused_normal(Op, y: Vector, x0: Vector, damp, tol, *,
     traffic on memory-bound matvecs; enabled when
     ``Op.has_fused_normal``."""
     return _cgls_fused_any(Op, y, x0, damp, tol, niter=niter,
-                           normal=True, guards=guards, stall_n=stall_n,
-                           fault=fault)
+                           normal=True, guards=guards, M=M,
+                           stall_n=stall_n, fault=fault)
 
 
 # Bounded LRU of compiled fused solvers. The operator itself is stored
@@ -684,7 +747,7 @@ def clear_fused_cache() -> None:
     _FUSED_CACHE.clear()
 
 
-def _get_fused(Op, key, make_builder, donate_argnums=()):
+def _get_fused(Op, key, make_builder, donate_argnums=(), keepalive=None):
     """Compile (and cache) the fused loop for ``Op``.
     ``make_builder(op)`` must return the loop with that operator bound;
     the returned fn is called with POSITIONAL runtime operands (the
@@ -701,7 +764,11 @@ def _get_fused(Op, key, make_builder, donate_argnums=()):
     buffers are traced, not closed over, which multi-process JAX
     requires for arrays spanning non-addressable devices (exercised by
     tests/multihost_worker.py). Unregistered operators keep the
-    closure form."""
+    closure form.
+
+    ``keepalive`` pins any extra object whose ``id()`` participates in
+    ``key`` (the preconditioner ``M``) for the life of the cache entry,
+    so a freed-then-reallocated object can never alias a stale key."""
     from ..linearoperator import operator_is_jit_arg
     from ..ops._precision import donation_enabled
     donate = tuple(donate_argnums) if donation_enabled() else ()
@@ -719,7 +786,7 @@ def _get_fused(Op, key, make_builder, donate_argnums=()):
                 return _jfn(_op, *a)
         else:
             fn = jax.jit(make_builder(Op), donate_argnums=donate)
-        entry = (fn, Op)
+        entry = (fn, Op, keepalive)
         _FUSED_CACHE[key] = entry
         if len(_FUSED_CACHE) > _FUSED_CACHE_MAX:
             _FUSED_CACHE.popitem(last=False)
@@ -739,22 +806,25 @@ def _donate_copy(v: Vector) -> Vector:
 
 
 def _run_cg_fused(Op, y: Vector, x0: Vector, x0_owned: bool, niter: int,
-                  tol, guards: bool):
+                  tol, guards: bool, M=None):
     """Compile-cache-and-run the fused CG loop. Returns ``(x, iiter,
     cost, status_code)`` — ``status_code`` is ``None`` on the unguarded
     path (whose traced program is bit-identical to the pre-guard
-    build; the guard carries only exist under ``guards=True``)."""
+    build; the guard carries only exist under ``guards=True``).
+    ``M=None`` leaves the cache key byte-identical to the pre-seam
+    layout (``_mkey`` contributes nothing), so unpreconditioned solves
+    reuse existing entries."""
     if guards:
         from ..resilience import faults as _faults, status as _rstatus
         spec = _faults.consume()
         stall_n = _rstatus.stall_window()
         fn = _get_fused(Op, (id(Op), "cg", niter, _vkey(y), _vkey(x0),
                              _rstatus.guards_signature(True),
-                             _faults.fault_signature(spec)),
+                             _faults.fault_signature(spec)) + _mkey(M),
                         lambda op: partial(_cg_fused, op, niter=niter,
-                                           guards=True, stall_n=stall_n,
-                                           fault=spec),
-                        donate_argnums=_DONATE_X0)
+                                           guards=True, M=M,
+                                           stall_n=stall_n, fault=spec),
+                        donate_argnums=_DONATE_X0, keepalive=M)
         x, iiter, cost, status = fn(
             y, x0 if x0_owned else _donate_copy(x0), tol)
         iiter, code = int(iiter), int(status)
@@ -762,9 +832,10 @@ def _run_cg_fused(Op, y: Vector, x0: Vector, x0_owned: bool, niter: int,
         _metrics.inc("solver.cg.solves")
         _metrics.inc("solver.cg.iterations", iiter)
         return x, iiter, np.asarray(cost)[:iiter + 1], code
-    fn = _get_fused(Op, (id(Op), "cg", niter, _vkey(y), _vkey(x0)),
-                    lambda op: partial(_cg_fused, op, niter=niter),
-                    donate_argnums=_DONATE_X0)
+    fn = _get_fused(Op, (id(Op), "cg", niter, _vkey(y),
+                         _vkey(x0)) + _mkey(M),
+                    lambda op: partial(_cg_fused, op, niter=niter, M=M),
+                    donate_argnums=_DONATE_X0, keepalive=M)
     x, iiter, cost = fn(y, x0 if x0_owned else _donate_copy(x0), tol)
     iiter = int(iiter)
     # host-side, AFTER the fused loop returned: metrics never add an
@@ -777,13 +848,18 @@ def _run_cg_fused(Op, y: Vector, x0: Vector, x0_owned: bool, niter: int,
 def cg(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
        tol: float = 1e-4, show: bool = False, itershow=(10, 10, 10),
        callback: Optional[Callable] = None, fused: Optional[bool] = None,
-       guards: Optional[bool] = None) -> Tuple[Vector, int, np.ndarray]:
+       guards: Optional[bool] = None,
+       M=None) -> Tuple[Vector, int, np.ndarray]:
     """Functional CG (ref ``optimization/basic.py:13-70``). With no
     callback/show, runs the fused on-device loop. ``guards`` resolves
     against ``PYLOPS_MPI_TPU_GUARDS`` (resilience/status.py): guarded
     fused solves can exit early on breakdown/stagnation — the return
     signature is unchanged, the status word lands in
-    ``resilience.status.last_status("cg")``."""
+    ``resilience.status.last_status("cg")``.
+
+    ``M`` is an optional preconditioner (an ``MPILinearOperator``
+    approximating ``Op⁻¹``, SPD) applied to the residual inside the
+    fused while_loop — see docs/preconditioning.md. Fused path only."""
     x0_owned = x0 is None  # freshly built → donate without a copy
     if x0 is None:
         x0 = _zero_like_model(Op, y)
@@ -791,6 +867,9 @@ def cg(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
     if use_fused and (callback is not None or show):
         raise ValueError("fused=True cannot honor callback/show; use "
                          "fused=False for per-iteration hooks")
+    if M is not None and not use_fused:
+        raise ValueError("M= (preconditioning) requires the fused path; "
+                         "drop callback/show or pass fused=True")
     from ..resilience.status import guards_enabled
     use_guards = use_fused and guards_enabled(guards)
     with _trace.span("solver.cg", cat="solver", op=type(Op).__name__,
@@ -800,7 +879,8 @@ def cg(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
             _metrics.timer("solver.cg"):
         if use_fused:
             x, iiter, cost, _ = _run_cg_fused(Op, y, x0, x0_owned,
-                                              niter, tol, use_guards)
+                                              niter, tol, use_guards,
+                                              M=M)
             return x, iiter, cost
         solver = CG(Op)
         solver._callback_wrap(callback)
@@ -810,7 +890,7 @@ def cg(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
 
 
 def cg_guarded(Op, y: Vector, x0: Optional[Vector] = None,
-               niter: int = 10, tol: float = 1e-4):
+               niter: int = 10, tol: float = 1e-4, M=None):
     """Guarded fused CG with an explicit status word: returns
     ``(x, iiter, cost, status_code)`` where the code is one of
     ``resilience.status.{CONVERGED, MAXITER, BREAKDOWN, STAGNATION}``.
@@ -824,14 +904,15 @@ def cg_guarded(Op, y: Vector, x0: Optional[Vector] = None,
                      tol=tol, fused=True, guards=True,
                      telemetry=telemetry.telemetry_enabled()), \
             _metrics.timer("solver.cg"):
-        return _run_cg_fused(Op, y, x0, x0_owned, niter, tol, True)
+        return _run_cg_fused(Op, y, x0, x0_owned, niter, tol, True, M=M)
 
 
 def _run_cgls_fused(Op, y: Vector, x0: Vector, x0_owned: bool,
                     niter: int, damp, tol, use_normal: bool,
-                    guards: bool):
+                    guards: bool, M=None):
     """Compile-cache-and-run the fused CGLS loop; see
-    :func:`_run_cg_fused` for the guard/status contract. Returns
+    :func:`_run_cg_fused` for the guard/status contract (including the
+    ``M=None`` cache-key neutrality). Returns
     ``(x, iiter, cost, cost1, kold, status_code_or_None)``."""
     builder = _cgls_fused_normal if use_normal else _cgls_fused
     if guards:
@@ -841,11 +922,11 @@ def _run_cgls_fused(Op, y: Vector, x0: Vector, x0_owned: bool,
         fn = _get_fused(Op, (id(Op), "cgls", use_normal, niter,
                              _vkey(y), _vkey(x0),
                              _rstatus.guards_signature(True),
-                             _faults.fault_signature(spec)),
+                             _faults.fault_signature(spec)) + _mkey(M),
                         lambda op: partial(builder, op, niter=niter,
-                                           guards=True, stall_n=stall_n,
-                                           fault=spec),
-                        donate_argnums=_DONATE_X0)
+                                           guards=True, M=M,
+                                           stall_n=stall_n, fault=spec),
+                        donate_argnums=_DONATE_X0, keepalive=M)
         x, iiter, cost, cost1, kold, status = fn(
             y, x0 if x0_owned else _donate_copy(x0), damp, tol)
         iiter, code = int(iiter), int(status)
@@ -855,9 +936,9 @@ def _run_cgls_fused(Op, y: Vector, x0: Vector, x0_owned: bool,
         return (x, iiter, np.asarray(cost)[:iiter + 1],
                 np.asarray(cost1)[:iiter + 1], kold, code)
     fn = _get_fused(Op, (id(Op), "cgls", use_normal, niter,
-                         _vkey(y), _vkey(x0)),
-                    lambda op: partial(builder, op, niter=niter),
-                    donate_argnums=_DONATE_X0)
+                         _vkey(y), _vkey(x0)) + _mkey(M),
+                    lambda op: partial(builder, op, niter=niter, M=M),
+                    donate_argnums=_DONATE_X0, keepalive=M)
     x, iiter, cost, cost1, kold = fn(
         y, x0 if x0_owned else _donate_copy(x0), damp, tol)
     iiter = int(iiter)
@@ -871,7 +952,7 @@ def cgls(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
          damp: float = 0.0, tol: float = 1e-4, show: bool = False,
          itershow=(10, 10, 10), callback: Optional[Callable] = None,
          fused: Optional[bool] = None, normal: Optional[bool] = None,
-         guards: Optional[bool] = None):
+         guards: Optional[bool] = None, M=None):
     """Functional CGLS (ref ``optimization/basic.py:73-148``).
 
     ``normal=True`` selects the one-sweep normal-equations iteration
@@ -880,7 +961,12 @@ def cgls(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
     its gradient recurrence drifts slightly in f32, so it is opt-in.
     ``guards`` resolves against ``PYLOPS_MPI_TPU_GUARDS`` (see
     :func:`cg`); the status word lands in
-    ``resilience.status.last_status("cgls")``."""
+    ``resilience.status.last_status("cgls")``.
+
+    ``M`` is an optional preconditioner for the NORMAL system — an SPD
+    ``MPILinearOperator`` approximating ``(OpᴴOp + damp²I)⁻¹``, applied
+    to the normal residual ``Opᴴ s − damp² x`` inside the fused loop
+    (docs/preconditioning.md). Fused path only."""
     x0_owned = x0 is None  # freshly built → donate without a copy
     if x0 is None:
         x0 = _zero_like_model(Op, y)
@@ -888,6 +974,9 @@ def cgls(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
     if use_fused and (callback is not None or show):
         raise ValueError("fused=True cannot honor callback/show; use "
                          "fused=False for per-iteration hooks")
+    if M is not None and not use_fused:
+        raise ValueError("M= (preconditioning) requires the fused path; "
+                         "drop callback/show or pass fused=True")
     use_normal = bool(normal)
     if use_normal and not use_fused:
         raise ValueError("normal=True requires the fused path; drop "
@@ -903,7 +992,7 @@ def cgls(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
         if use_fused:
             x, iiter, cost, cost1, kold, _ = _run_cgls_fused(
                 Op, y, x0, x0_owned, niter, damp, tol, use_normal,
-                use_guards)
+                use_guards, M=M)
             istop = 1 if float(jnp.max(kold)) < tol else 2
             return x, istop, iiter, kold, cost1[-1], cost
         solver = CGLS(Op)
@@ -914,7 +1003,7 @@ def cgls(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
 
 def cgls_guarded(Op, y: Vector, x0: Optional[Vector] = None,
                  niter: int = 10, damp: float = 0.0, tol: float = 1e-4,
-                 normal: bool = False):
+                 normal: bool = False, M=None):
     """Guarded fused CGLS with an explicit status word: returns
     ``(x, iiter, cost, cost1, kold, status_code)``; see
     :func:`cg_guarded` for the status contract."""
@@ -928,7 +1017,7 @@ def cgls_guarded(Op, y: Vector, x0: Optional[Vector] = None,
                      telemetry=telemetry.telemetry_enabled()), \
             _metrics.timer("solver.cgls"):
         return _run_cgls_fused(Op, y, x0, x0_owned, niter, damp, tol,
-                               bool(normal), True)
+                               bool(normal), True, M=M)
 
 
 def _vkey(v: Vector):

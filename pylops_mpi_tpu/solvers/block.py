@@ -49,8 +49,9 @@ from jax import lax
 from ..distributedarray import DistributedArray
 from ..diagnostics import metrics as _metrics
 from ..diagnostics import telemetry, trace as _trace
-from .basic import (_DONATE_X0, _donate_copy, _get_fused, _i32,
-                    _mp_floor, _reject, _step_scalar, _vdtype, _vkey)
+from .basic import (_DONATE_X0, _donate_copy, _get_fused, _i32, _mkey,
+                    _mp_floor, _precond_apply, _precond_signature,
+                    _reject, _step_scalar, _vdtype, _vkey)
 
 __all__ = ["block_cg", "block_cgls", "block_cg_segmented",
            "batched_solve", "BatchedResult", "batched_cache_info"]
@@ -130,13 +131,21 @@ def _bresolve(status, kold, tol):
 
 
 # ------------------------------------------------------ fused block loops
-def _make_block_cg_body(Op, xdt, floors, tol, *, guards=False,
+def _make_block_cg_body(Op, xdt, floors, tol, *, M=None, guards=False,
                         carry_status=False, stall_n=0):
     """Block-CG loop body over ``(x, r, c, kold, iiter, cost
     [, status][, bestk, stall])`` with every recurrence scalar a
     ``(K,)`` vector. Columns freeze individually — at the
     machine-precision floor, at ``tol``, or once their status word
-    closes — by zeroing their step/momentum lanes."""
+    closes — by zeroing their step/momentum lanes.
+
+    ``M`` preconditions ALL K columns in one apply: ``z = M r`` is one
+    block matvec on the ``(n, K)`` residual (operators route 2-D
+    inputs through their widened paths or the ``_apply_columns`` vmap
+    fallback), and the recurrence becomes ``kold = r·z`` per column.
+    The carry layout is unchanged — ``z`` is recomputed each
+    iteration, never carried — and ``M=None`` traces the identical
+    pre-seam program (``z`` IS ``r``)."""
     from ..resilience import status as _rstatus
 
     def body(state):
@@ -154,10 +163,11 @@ def _make_block_cg_body(Op, xdt, floors, tol, *, guards=False,
         a = jnp.where(done, jnp.zeros_like(a), a)
         xn = x + c * _step_scalar(a, xdt)
         rn = r - Opc * _step_scalar(a, xdt)
-        k = _bdot(rn, rn)
+        zn = _precond_apply(M, rn, xdt)
+        k = _bdot(rn, zn)
         k = jnp.where(done, kold, k)
         b = jnp.where(done, jnp.zeros_like(k), k / kold)
-        cn = rn + c * _step_scalar(b, xdt)
+        cn = zn + c * _step_scalar(b, xdt)
         if guards:
             # per-column verdicts: only the poisoned column's update is
             # rejected (its lane of the (K,) mask), siblings proceed
@@ -186,19 +196,20 @@ def _make_block_cg_body(Op, xdt, floors, tol, *, guards=False,
     return body
 
 
-def _block_cg_fused(Op, y, x0, tol, *, niter: int, guards: bool = False,
-                    stall_n: int = 0):
+def _block_cg_fused(Op, y, x0, tol, *, niter: int, M=None,
+                    guards: bool = False, stall_n: int = 0):
     from ..resilience import status as _rstatus
     xdt = _vdtype(x0)
     x = x0  # donated: the block carry aliases the caller's buffer
     r = y - Op.matvec(x)
-    c = r
-    kold = _bdot(r, r)
+    z = _precond_apply(M, r, xdt)
+    c = z
+    kold = _bdot(r, z)
     floors = _mp_floor(kold)
     cost0 = jnp.zeros((niter + 1,) + jnp.shape(kold),
                       dtype=jnp.asarray(kold).dtype)
     cost0 = lax.dynamic_update_index_in_dim(cost0, jnp.sqrt(kold), 0, 0)
-    body = _make_block_cg_body(Op, xdt, floors, tol, guards=guards,
+    body = _make_block_cg_body(Op, xdt, floors, tol, M=M, guards=guards,
                                stall_n=stall_n)
     if guards:
         K = kold.shape[0]
@@ -222,11 +233,13 @@ def _block_cg_fused(Op, y, x0, tol, *, niter: int, guards: bool = False,
     return x, iiter, cost
 
 
-def _make_block_cgls_body(Op, xdt, damp2, floors, tol, *, guards=False,
-                          carry_status=False, stall_n=0):
+def _make_block_cgls_body(Op, xdt, damp2, floors, tol, *, M=None,
+                          guards=False, carry_status=False, stall_n=0):
     """Block-CGLS (classic two-sweep) loop body over ``(x, s, c, q,
     kold, iiter, cost, cost1[, status][, bestk, stall])`` — per-column
-    scalars throughout; see :func:`_make_block_cg_body`."""
+    scalars throughout; see :func:`_make_block_cg_body`. ``M``
+    approximates ``(OpᴴOp + damp²I)⁻¹`` and is applied to the normal
+    residual, all K columns at once."""
     from ..resilience import status as _rstatus
 
     def body(state):
@@ -245,10 +258,11 @@ def _make_block_cgls_body(Op, xdt, damp2, floors, tol, *, guards=False,
         xn = x + c * _step_scalar(a, xdt)
         sn_ = s - q * _step_scalar(a, xdt)
         r = Op.rmatvec(sn_) - xn * damp2
-        k = _bdot(r, r)
+        z = _precond_apply(M, r, xdt)
+        k = _bdot(r, z)
         k = jnp.where(done, kold, k)
         b = jnp.where(done, jnp.zeros_like(k), k / kold)
-        cn = r + c * _step_scalar(b, xdt)
+        cn = z + c * _step_scalar(b, xdt)
         qn = Op.matvec(cn)
         if guards:
             bad = (~jnp.isfinite(a)) | (~jnp.isfinite(k)) \
@@ -278,7 +292,7 @@ def _make_block_cgls_body(Op, xdt, damp2, floors, tol, *, guards=False,
     return body
 
 
-def _block_cgls_fused(Op, y, x0, damp, tol, *, niter: int,
+def _block_cgls_fused(Op, y, x0, damp, tol, *, niter: int, M=None,
                       guards: bool = False, stall_n: int = 0):
     from ..resilience import status as _rstatus
     damp2 = damp ** 2
@@ -286,9 +300,10 @@ def _block_cgls_fused(Op, y, x0, damp, tol, *, niter: int,
     x = x0  # donated (see _DONATE_X0)
     s = y - Op.matvec(x)
     rq = Op.rmatvec(s) - x * damp  # the reference's un-squared setup
-    c = rq                         # damp quirk (solvers/basic module doc)
+    z = _precond_apply(M, rq, xdt)  # damp quirk (solvers/basic module
+    c = z                           # doc); M seeds the first direction
     q = Op.matvec(c)
-    kold = _bdot(rq, rq)
+    kold = _bdot(rq, z)
     floors = _mp_floor(kold)
     sn0 = jnp.sqrt(_bdot(s, s))
     cost0 = jnp.zeros((niter + 1,) + jnp.shape(sn0), dtype=sn0.dtype)
@@ -296,7 +311,7 @@ def _block_cgls_fused(Op, y, x0, damp, tol, *, niter: int,
     cost1_0 = lax.dynamic_update_index_in_dim(
         jnp.zeros_like(cost0),
         jnp.sqrt(sn0 ** 2 + damp2 * _bdot(x, x)), 0, 0)
-    body = _make_block_cgls_body(Op, xdt, damp2, floors, tol,
+    body = _make_block_cgls_body(Op, xdt, damp2, floors, tol, M=M,
                                  guards=guards, stall_n=stall_n)
     if guards:
         K = kold.shape[0]
@@ -325,7 +340,8 @@ def _block_cgls_fused(Op, y, x0, damp, tol, *, niter: int,
 # ------------------------------------------------------ public wrappers
 def block_cg(Op, y: DistributedArray,
              x0: Optional[DistributedArray] = None, niter: int = 10,
-             tol: float = 1e-4, guards: Optional[bool] = None):
+             tol: float = 1e-4, guards: Optional[bool] = None,
+             M=None):
     """Fused block CG: K RHS columns through one ``lax.while_loop``.
 
     ``y`` (and the optional ``x0``) are 2-D ``(n, K)``
@@ -353,7 +369,7 @@ def block_cg(Op, y: DistributedArray,
             from .basic import _run_cg_fused
             x1, iiter, cost, code = _run_cg_fused(
                 Op, _squeeze_col(y), _squeeze_col(x0), True, niter,
-                tol, use_guards)
+                tol, use_guards, M=M)
             if use_guards:
                 _rstatus.record_columns("block_cg", [code], iiter)
             return _expand_col(x1), iiter, np.asarray(cost)[:, None]
@@ -362,10 +378,10 @@ def block_cg(Op, y: DistributedArray,
             stall_n = _rstatus.stall_window()
             fn = _get_fused(
                 Op, (id(Op), "block_cg", niter, _vkey(y), _vkey(x0),
-                     _rstatus.guards_signature(True)),
+                     _rstatus.guards_signature(True)) + _mkey(M),
                 lambda op: partial(_block_cg_fused, op, niter=niter,
-                                   guards=True, stall_n=stall_n),
-                donate_argnums=_DONATE_X0)
+                                   M=M, guards=True, stall_n=stall_n),
+                donate_argnums=_DONATE_X0, keepalive=M)
             x, iiter, cost, status = fn(
                 y, x0 if x0_owned else _donate_copy(x0), tol)
             iiter = int(iiter)
@@ -376,10 +392,10 @@ def block_cg(Op, y: DistributedArray,
                 iiter)
             return x, iiter, np.asarray(cost)[:iiter + 1]
         fn = _get_fused(Op, (id(Op), "block_cg", niter, _vkey(y),
-                             _vkey(x0)),
+                             _vkey(x0)) + _mkey(M),
                         lambda op: partial(_block_cg_fused, op,
-                                           niter=niter),
-                        donate_argnums=_DONATE_X0)
+                                           niter=niter, M=M),
+                        donate_argnums=_DONATE_X0, keepalive=M)
         x, iiter, cost = fn(y, x0 if x0_owned else _donate_copy(x0),
                             tol)
         iiter = int(iiter)
@@ -391,7 +407,7 @@ def block_cg(Op, y: DistributedArray,
 def block_cgls(Op, y: DistributedArray,
                x0: Optional[DistributedArray] = None, niter: int = 10,
                damp: float = 0.0, tol: float = 1e-4,
-               guards: Optional[bool] = None):
+               guards: Optional[bool] = None, M=None):
     """Fused block CGLS (classic two-sweep schedule); see
     :func:`block_cg`. Returns ``(x, istop, iiter, kold, r2norm,
     cost)`` — the :func:`~pylops_mpi_tpu.solvers.basic.cgls` shape with
@@ -414,7 +430,7 @@ def block_cgls(Op, y: DistributedArray,
             from .basic import _run_cgls_fused
             x1, iiter, cost, cost1, kold, code = _run_cgls_fused(
                 Op, _squeeze_col(y), _squeeze_col(x0), True, niter,
-                damp, tol, False, use_guards)
+                damp, tol, False, use_guards, M=M)
             if use_guards:
                 _rstatus.record_columns("block_cgls", [code], iiter)
             kold = np.atleast_1d(np.asarray(kold))
@@ -427,10 +443,10 @@ def block_cgls(Op, y: DistributedArray,
             stall_n = _rstatus.stall_window()
             fn = _get_fused(
                 Op, (id(Op), "block_cgls", niter, _vkey(y), _vkey(x0),
-                     _rstatus.guards_signature(True)),
+                     _rstatus.guards_signature(True)) + _mkey(M),
                 lambda op: partial(_block_cgls_fused, op, niter=niter,
-                                   guards=True, stall_n=stall_n),
-                donate_argnums=_DONATE_X0)
+                                   M=M, guards=True, stall_n=stall_n),
+                donate_argnums=_DONATE_X0, keepalive=M)
             x, iiter, cost, cost1, kold, status = fn(
                 y, x0 if x0_owned else _donate_copy(x0), damp, tol)
             iiter = int(iiter)
@@ -441,10 +457,10 @@ def block_cgls(Op, y: DistributedArray,
                 iiter)
         else:
             fn = _get_fused(Op, (id(Op), "block_cgls", niter, _vkey(y),
-                                 _vkey(x0)),
+                                 _vkey(x0)) + _mkey(M),
                             lambda op: partial(_block_cgls_fused, op,
-                                               niter=niter),
-                            donate_argnums=_DONATE_X0)
+                                               niter=niter, M=M),
+                            donate_argnums=_DONATE_X0, keepalive=M)
             x, iiter, cost, cost1, kold = fn(
                 y, x0 if x0_owned else _donate_copy(x0), damp, tol)
             iiter = int(iiter)
@@ -458,12 +474,13 @@ def block_cgls(Op, y: DistributedArray,
 
 
 # ------------------------------------------------------ segmented blocks
-def _block_cg_setup_builder(Op, *, niter):
+def _block_cg_setup_builder(Op, *, niter, M=None):
     def setup(y, x0):
         x = x0
         r = y - Op.matvec(x)
-        c = r
-        kold = _bdot(r, r)
+        z = _precond_apply(M, r, _vdtype(x0))
+        c = z
+        kold = _bdot(r, z)
         floors = _mp_floor(kold)
         cost0 = jnp.zeros((niter + 1,) + jnp.shape(kold),
                           dtype=jnp.asarray(kold).dtype)
@@ -474,11 +491,11 @@ def _block_cg_setup_builder(Op, *, niter):
     return setup
 
 
-def _block_cg_epoch_builder(Op, *, guards, stall_n):
+def _block_cg_epoch_builder(Op, *, guards, stall_n, M=None):
     def run(y, x, r, c, kold, iiter, cost, status, bestk, stall,
             floors, tol, epoch_end):
         from ..resilience import status as _rstatus
-        body = _make_block_cg_body(Op, _vdtype(x), floors, tol,
+        body = _make_block_cg_body(Op, _vdtype(x), floors, tol, M=M,
                                    guards=guards,
                                    carry_status=not guards,
                                    stall_n=stall_n)
@@ -514,7 +531,7 @@ def block_cg_segmented(Op, y: DistributedArray,
                        resume: bool = True,
                        backend: Optional[str] = None,
                        guards: Optional[bool] = None,
-                       on_epoch=None):
+                       on_epoch=None, M=None):
     """Segmented block CG: epochs of fused block iterations with the
     whole ``(n, K)`` carry checkpointed between epochs
     (``utils/checkpoint.save_fused_carry`` round-trips any-ndim
@@ -537,7 +554,7 @@ def block_cg_segmented(Op, y: DistributedArray,
     if x0 is None:
         x0 = _zero_block_model(Op, y)
     meta = {"niter": niter, "tol": float(tol), "guards": guards_on,
-            "batch": K}
+            "batch": K, "precond": _precond_signature(M)}
     state = (_load_carry(checkpoint_path, "block_cg", y.mesh, meta)
              if resume else None)
     resumed = state is not None
@@ -551,8 +568,10 @@ def block_cg_segmented(Op, y: DistributedArray,
         if state is None:
             setup = _get_fused(
                 Op, (id(Op), "block_cg-seg-setup", niter, _vkey(y),
-                     _vkey(x0)),
-                lambda op: _block_cg_setup_builder(op, niter=niter))
+                     _vkey(x0)) + _mkey(M),
+                lambda op: _block_cg_setup_builder(op, niter=niter,
+                                                   M=M),
+                keepalive=M)
             x, r, c, kold, cost, floors = setup(y, x0)
             state = dict(zip(fields, [
                 x, r, c, kold, jnp.asarray(0), cost, _status0(K),
@@ -560,9 +579,11 @@ def block_cg_segmented(Op, y: DistributedArray,
             state["floors"] = floors
         run = _get_fused(
             Op, (id(Op), "block_cg-seg", niter, _vkey(y), _vkey(x0),
-                 ("guards", guards_on, stall_n if guards_on else None)),
+                 ("guards", guards_on,
+                  stall_n if guards_on else None)) + _mkey(M),
             lambda op: _block_cg_epoch_builder(op, guards=guards_on,
-                                               stall_n=stall_n))
+                                               stall_n=stall_n, M=M),
+            keepalive=M)
         epochs = 0
         while True:
             iiter = int(state["iiter"])

@@ -38,8 +38,9 @@ from jax import lax
 from ..distributedarray import DistributedArray
 from ..diagnostics import trace as _trace
 from .basic import (Vector, _get_fused, _vkey, _vdtype,
-                    _zero_like_model, _rdot, _mp_floor, _i32,
-                    _make_cg_body, _make_cgls_body, _cgls_setup)
+                    _zero_like_model, _rdot, _mp_floor, _i32, _mkey,
+                    _make_cg_body, _make_cgls_body, _cgls_setup,
+                    _precond_apply, _precond_signature)
 
 __all__ = ["cg_segmented", "cgls_segmented", "SegmentedResult",
            "resolve_epoch"]
@@ -78,10 +79,10 @@ def _guard_params(guards):
 
 
 # ------------------------------------------------------ epoch programs
-def _cg_epoch_builder(Op, *, niter, guards, stall_n):
+def _cg_epoch_builder(Op, *, niter, guards, stall_n, M=None):
     def run(y, x, r, c, kold, iiter, cost, status, bestk, stall,
             floors, tol, epoch_end):
-        body = _make_cg_body(Op, _vdtype(x), floors, guards=guards,
+        body = _make_cg_body(Op, _vdtype(x), floors, M=M, guards=guards,
                              carry_status=not guards, stall_n=stall_n)
         if guards:
             from ..resilience import status as _rstatus
@@ -103,10 +104,10 @@ def _cg_epoch_builder(Op, *, niter, guards, stall_n):
     return run
 
 
-def _cgls_epoch_builder(Op, *, niter, guards, stall_n):
+def _cgls_epoch_builder(Op, *, niter, guards, stall_n, M=None):
     def run(y, x, s, c, q, kold, iiter, cost, cost1, status, bestk,
             stall, floors, damp2, tol, epoch_end):
-        body = _make_cgls_body(Op, _vdtype(x), damp2, floors,
+        body = _make_cgls_body(Op, _vdtype(x), damp2, floors, M=M,
                                normal=False, guards=guards,
                                carry_status=not guards, stall_n=stall_n)
         if guards:
@@ -130,12 +131,13 @@ def _cgls_epoch_builder(Op, *, niter, guards, stall_n):
     return run
 
 
-def _cg_setup_builder(Op, *, niter):
+def _cg_setup_builder(Op, *, niter, M=None):
     def setup(y, x0):
         x = x0
         r = y - Op.matvec(x)
-        c = r
-        kold = _rdot(r, r)
+        z = _precond_apply(M, r, _vdtype(x0))
+        c = z
+        kold = _rdot(r, z)
         floors = _mp_floor(kold)
         cost0 = jnp.zeros((niter + 1,) + jnp.shape(kold),
                           dtype=jnp.asarray(kold).dtype)
@@ -146,10 +148,10 @@ def _cg_setup_builder(Op, *, niter):
     return setup
 
 
-def _cgls_setup_builder(Op, *, niter):
+def _cgls_setup_builder(Op, *, niter, M=None):
     def setup(y, x0, damp, damp2):
         head, floors, cost0, cost1_0 = _cgls_setup(
-            Op, y, x0, damp, damp2, niter=niter, normal=False)
+            Op, y, x0, damp, damp2, niter=niter, normal=False, M=M)
         return head + (cost0, cost1_0, floors)
 
     return setup
@@ -198,16 +200,20 @@ def cg_segmented(Op, y: Vector, x0: Optional[Vector] = None,
                  resume: bool = True, backend: Optional[str] = None,
                  guards: Optional[bool] = None,
                  on_epoch: Optional[Callable] = None,
-                 resume_state: Optional[dict] = None) -> SegmentedResult:
+                 resume_state: Optional[dict] = None,
+                 M=None) -> SegmentedResult:
     """Segmented fused CG: epochs of ``epoch`` fused iterations,
     checkpointed to ``checkpoint_path`` after every epoch (when given)
     and auto-resumed from it (``resume=True``) after a kill.
     ``resume_state`` resumes from an in-memory carry instead — the
     in-place elastic path hands the replanted bank here so recovery
-    never touches checkpoint I/O."""
+    never touches checkpoint I/O. ``M`` preconditions the fused
+    epochs; its signature is banked in the checkpoint meta, so a
+    resume under a DIFFERENT preconditioner refuses (the trajectory
+    would silently diverge from the banked one)."""
     return _segmented(Op, y, x0, "cg", niter, 0.0, tol, epoch,
                       checkpoint_path, resume, backend, guards, on_epoch,
-                      resume_state)
+                      resume_state, M=M)
 
 
 def cgls_segmented(Op, y: Vector, x0: Optional[Vector] = None,
@@ -217,7 +223,8 @@ def cgls_segmented(Op, y: Vector, x0: Optional[Vector] = None,
                    resume: bool = True, backend: Optional[str] = None,
                    guards: Optional[bool] = None,
                    on_epoch: Optional[Callable] = None,
-                   resume_state: Optional[dict] = None) -> SegmentedResult:
+                   resume_state: Optional[dict] = None,
+                   M=None) -> SegmentedResult:
     """Segmented fused CGLS (classic two-sweep schedule); see
     :func:`cg_segmented`. A killed process re-invoking with the same
     ``checkpoint_path`` (and the same ``niter``/``damp``/``tol``)
@@ -229,7 +236,7 @@ def cgls_segmented(Op, y: Vector, x0: Optional[Vector] = None,
     recovery path free of checkpoint reads."""
     return _segmented(Op, y, x0, "cgls", niter, damp, tol, epoch,
                       checkpoint_path, resume, backend, guards, on_epoch,
-                      resume_state)
+                      resume_state, M=M)
 
 
 _CG_FIELDS = ("x", "r", "c", "kold", "iiter", "cost", "status",
@@ -257,7 +264,7 @@ def _check_resume_state(state, expect):
 
 def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
                checkpoint_path, resume, backend, guards, on_epoch,
-               resume_state=None):
+               resume_state=None, M=None):
     from ..resilience import status as _rstatus
     from ..resilience import elastic as _elastic
     from ..resilience.elastic import maybe_start_heartbeat
@@ -274,7 +281,8 @@ def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
     mesh = y.mesh if isinstance(y, DistributedArray) else None
     damp2 = damp ** 2
 
-    meta = {"niter": niter, "tol": float(tol), "guards": guards_on}
+    meta = {"niter": niter, "tol": float(tol), "guards": guards_on,
+            "precond": _precond_signature(M)}
     if is_cgls:
         meta["damp"] = float(damp)
     if resume_state is not None:
@@ -295,8 +303,10 @@ def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
             setup_builder = (_cgls_setup_builder if is_cgls
                              else _cg_setup_builder)
             setup = _get_fused(Op, (id(Op), f"{solver}-seg-setup", niter,
-                                    _vkey(y), _vkey(x0)),
-                               lambda op: setup_builder(op, niter=niter))
+                                    _vkey(y), _vkey(x0)) + _mkey(M),
+                               lambda op: setup_builder(op, niter=niter,
+                                                        M=M),
+                               keepalive=M)
             out = setup(y, x0, damp, damp2) if is_cgls else setup(y, x0)
             if is_cgls:
                 x, s, c, q, kold, cost, cost1, floors = out
@@ -312,10 +322,12 @@ def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
         run = _get_fused(Op, (id(Op), f"{solver}-seg", niter,
                               _vkey(y), _vkey(x0),
                               ("guards", guards_on,
-                               stall_n if guards_on else None)),
+                               stall_n if guards_on else None))
+                         + _mkey(M),
                          lambda op: run_builder(op, niter=niter,
                                                 guards=guards_on,
-                                                stall_n=stall_n))
+                                                stall_n=stall_n, M=M),
+                         keepalive=M)
 
         epochs = 0
         while True:

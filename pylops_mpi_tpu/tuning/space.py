@@ -438,6 +438,43 @@ register_space(TuningSpace(
          "PYLOPS_MPI_TPU_FFT_MODE seam (complex-free HLO pins) "
          "— recorded in the plan, never flipped by the tuner"))
 
+def _cost_sparse_tier(context: Dict, params: Dict) -> Optional[float]:
+    """Dense-vs-sparse matmul tier seed: both tiers priced on the
+    roofline (flops when a peak is known, always bytes). The sparse
+    tier streams ``nnz`` triplets (value + two int32 indices); the
+    dense tier streams the full ``N·M`` matrix — the crossover sits
+    near ``nnz ≈ N·M·it/(it+8)`` (≈ N·M/3 at f32), so ≥90% sparsity
+    picks sparse with a wide margin."""
+    shape = context.get("shape") or (1, 1)
+    N, M = int(shape[0]), int(shape[1])
+    extra = context.get("extra") or {}
+    nnz = int(extra.get("nnz") or N * M)
+    it = int(extra.get("itemsize") or 4)
+    nd = max(1, int(context.get("n_dev") or 1))
+    pk = _peaks(context)
+    bw = (pk.get("hbm_gbps") or 30.0) * 1e9
+    if params.get("tier") == "sparse":
+        bytes_ = nnz * (it + 8.0) / nd + (N + M) * it
+        flops = 2.0 * nnz / nd
+    else:
+        bytes_ = N * M * float(it) / nd + (N + M) * it
+        flops = 2.0 * N * M / nd
+    t = bytes_ / bw
+    if pk.get("flops"):
+        t = max(t, flops / pk["flops"])
+    return t
+
+
+register_space(TuningSpace(
+    op="sparse_matmult",
+    axes=(Axis("tier", ("dense", "sparse")),),
+    cost=_cost_sparse_tier,
+    note="matmul storage tier: dense GEMM (MPIMatrixMult) vs nnz-"
+         "scaled gather/segment-sum (MPISparseMatrixMult); nnz rides "
+         "in the plan key's extra so the same logical shape can "
+         "resolve differently per sparsity — tuning off always means "
+         "dense (the bit-identity pin)"))
+
 register_space(TuningSpace(
     op="blockdiag",
     axes=(Axis("normal_path", ("fused", "two_sweep")),

@@ -298,6 +298,21 @@ KNOBS = [
      "serving/service.py",
      "graceful-drain bound: how long SIGTERM/drain waits for "
      "in-flight batches before giving up"),
+    ("PYLOPS_MPI_TPU_PRECOND", "none|jacobi|block_jacobi|mg", "none",
+     "ops/precond.py",
+     "default preconditioner kind make_precond builds when no "
+     "explicit kind is passed (solvers stay unpreconditioned — and "
+     "bit-identical — unless a call site opts in with M=)"),
+    ("PYLOPS_MPI_TPU_MG_LEVELS", "int>=1", "3",
+     "ops/precond.py",
+     "V-cycle depth VCyclePrecond builds when levels= is not given "
+     "(auto-reduced when grid divisibility runs out first)"),
+    ("PYLOPS_MPI_TPU_REFINE", "0|1", "0",
+     "resilience/driver.py",
+     "iterative-refinement gate: resilient_solve turns "
+     "precision-escalation restarts into narrow-inner-solve + "
+     "wide-correction refinement passes instead of full wide "
+     "re-solves"),
 ]
 
 
@@ -335,6 +350,32 @@ def explicit_stencil_enabled() -> bool:
 
 def x64_enabled() -> bool:
     return os.environ.get("PYLOPS_MPI_TPU_X64", "0") == "1"
+
+
+def precond_default() -> str:
+    """``PYLOPS_MPI_TPU_PRECOND`` — the preconditioner kind
+    :func:`~pylops_mpi_tpu.ops.precond.make_precond` builds when the
+    caller passes no explicit ``kind``."""
+    return os.environ.get("PYLOPS_MPI_TPU_PRECOND", "none").strip() \
+        .lower() or "none"
+
+
+def mg_levels_default() -> int:
+    """``PYLOPS_MPI_TPU_MG_LEVELS`` — V-cycle depth (floored at 1; a
+    malformed value falls back to the default rather than breaking
+    construction)."""
+    try:
+        v = int(os.environ.get("PYLOPS_MPI_TPU_MG_LEVELS", "3"))
+    except ValueError:
+        v = 3
+    return max(1, v)
+
+
+def refine_enabled() -> bool:
+    """``PYLOPS_MPI_TPU_REFINE`` — when on, resilient_solve's
+    precision-escalation restarts run as iterative-refinement passes
+    (narrow inner solve + wide correction, resilience/driver.py)."""
+    return os.environ.get("PYLOPS_MPI_TPU_REFINE", "0") == "1"
 
 
 _warned_overlap = False
