@@ -710,6 +710,133 @@ def _sparse_race_row():
         return {"error": repr(e)[:300]}
 
 
+def _ca_race_row():
+    """Communication-avoiding solver race (CA-PR acceptance bar): a
+    fused CG solve under an injected per-collective latency floor
+    (``PYLOPS_MPI_TPU_REDUCE_STALL`` — a serial dependency chain the
+    compiler cannot elide, standing in for the all-reduce α-term the
+    single-host CPU sim cannot produce), classic two-reduction engine
+    vs the one-reduction pipelined engine on the same trajectory.
+    Stamps the body all-reduce counts (pinned via ``utils/hlo.py``
+    with the stall OFF — program truth, not timing), iteration parity
+    and the wall ratio. Error-isolated like every race row."""
+    saved = {k: os.environ.get(k) for k in
+             ("PYLOPS_MPI_TPU_CA", "PYLOPS_MPI_TPU_REDUCE_STALL")}
+
+    def _setenv(k, v):
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+    try:
+        import numpy as _np
+        import jax as _jax
+        from pylops_mpi_tpu import DistributedArray, MPIBlockDiag
+        from pylops_mpi_tpu.ops.local import MatrixMult
+        from pylops_mpi_tpu.solvers import cg, clear_fused_cache
+        from pylops_mpi_tpu.solvers import ca as _camod
+        from pylops_mpi_tpu.solvers.basic import _cg_fused
+        from pylops_mpi_tpu.utils import hlo as _hlo
+
+        rng = _np.random.default_rng(17)
+        nblk = max(len(_jax.devices()), 2)
+        nloc = 48
+        mats = []
+        for _ in range(nblk):
+            m = rng.standard_normal((nloc, nloc)).astype(_np.float32)
+            # conditioned to take a few dozen iterations — enough for
+            # the per-iteration latency floor to dominate the wall
+            mats.append((m @ m.T) * 0.5
+                        + 2.0 * _np.eye(nloc, dtype=_np.float32))
+        Op = MPIBlockDiag([MatrixMult(m, dtype=_np.float32)
+                           for m in mats])
+        n = nblk * nloc
+        xt = rng.standard_normal(n).astype(_np.float32)
+        yv = _np.asarray(Op.matvec(
+            DistributedArray.to_dist(xt)).asarray())
+        y = DistributedArray.to_dist(yv)
+        niter = 80
+        # the fused stop test is absolute on kold = r·r; with x0 = 0
+        # the standard relative criterion is rel² x ‖y‖²
+        tol = float(1e-4 ** 2 * _np.dot(yv.astype(_np.float64), yv))
+
+        def _x0():
+            return DistributedArray.to_dist(
+                _np.zeros(n, dtype=_np.float32))
+
+        # 1. program truth, stall OFF: all-reduces per while-body
+        _setenv("PYLOPS_MPI_TPU_REDUCE_STALL", None)
+        _setenv("PYLOPS_MPI_TPU_CA", "off")
+        clear_fused_cache()
+
+        def _classic_fn(y_, x_, t_):
+            return _cg_fused(Op, y_, x_, t_, niter=niter)
+
+        def _pipe_fn(y_, x_, t_):
+            return _camod._pipe_cg_fused(Op, y_, x_, t_, niter=niter)
+
+        red_classic = _hlo.count_reductions(
+            _hlo.compiled_hlo(_classic_fn, y, _x0(), 0.0), scope="body")
+        red_pipe = _hlo.count_reductions(
+            _hlo.compiled_hlo(_pipe_fn, y, _x0(), 0.0), scope="body")
+
+        # 2. the race, stall ON: every reduction pays the latency floor
+        stall = os.environ.get("BENCH_CA_STALL_PYLOPS_MPI_TPU", "4096")
+        _setenv("PYLOPS_MPI_TPU_REDUCE_STALL", stall)
+
+        def _arm(mode):
+            _setenv("PYLOPS_MPI_TPU_CA", mode)
+            clear_fused_cache()
+
+            def run():
+                out = cg(Op, y, _x0(), niter=niter, tol=tol,
+                         fused=True)
+                _jax.block_until_ready(out[0]._arr)
+                return out
+
+            out = run()              # compile outside timing
+            reps = 3
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = run()
+            t = (time.perf_counter() - t0) / reps
+            xs = _np.asarray(out[0].asarray())
+            err = float(_np.linalg.norm(xs - xt)
+                        / _np.linalg.norm(xt))
+            return int(out[1]), t, err
+
+        it0, t0s, e0 = _arm("off")
+        itp, tps, ep = _arm("pipelined")
+        parity = abs(itp - it0) <= max(2, int(round(0.1 * it0)))
+        return {
+            "problem": {"nblk": nblk, "nloc": nloc, "niter_cap": niter},
+            "host_stall_steps": int(stall),
+            "reductions_per_iter": {"classic": red_classic,
+                                    "pipelined": red_pipe},
+            "classic": {"iters": it0, "wall_s": _sig3(t0s),
+                        "rel_err": _sig3(e0),
+                        "solves_per_sec": _sig3(1.0 / t0s)},
+            "pipelined": {"iters": itp, "wall_s": _sig3(tps),
+                          "rel_err": _sig3(ep),
+                          "solves_per_sec": _sig3(1.0 / tps)},
+            # the sentinel sub-verdict rides this top-level rate
+            "solves_per_sec": _sig3(1.0 / tps) if tps else None,
+            "iters_parity": parity,
+            "wall_speedup": _sig3(t0s / tps) if tps else None,
+        }
+    except Exception as e:  # the race must never cost the headline
+        return {"error": repr(e)[:300]}
+    finally:
+        for k, v in saved.items():
+            _setenv(k, v)
+        try:
+            from pylops_mpi_tpu.solvers import clear_fused_cache
+            clear_fused_cache()
+        except Exception:
+            pass
+
+
 # dense matmul peak per chip, TFLOP/s (bf16 inputs, f32 accumulation on
 # the MXU) — public spec-sheet numbers; most-specific key checked first
 _PEAK_TFLOPS = [
@@ -1383,6 +1510,15 @@ def child_main():
         _progress("sparse-vs-dense matvec race (95% sparsity)")
         sparse_race = _sparse_race_row()
 
+    # communication-avoiding solver race (CA PR): classic vs pipelined
+    # CG under an injected per-collective latency floor; every CPU-sim
+    # round, BENCH_CA_PYLOPS_MPI_TPU=1 forces it on hardware too
+    ca_race = None
+    ca_env = os.environ.get("BENCH_CA_PYLOPS_MPI_TPU", "")
+    if ca_env != "0" and (not on_tpu or ca_env == "1"):
+        _progress("CA race (classic vs pipelined CG, stalled reduce)")
+        ca_race = _ca_race_row()
+
     peak_bf16 = _peak_flops_per_chip(jax.devices()[0], "bf16")
     peak_f32 = _peak_flops_per_chip(jax.devices()[0], "f32_highest")
     peak_hbm = _peak_hbm_gbps(jax.devices()[0]) if on_tpu else None
@@ -1403,10 +1539,17 @@ def child_main():
         try:
             nd = max(n_dev, 1)
             sweeps = 1 if "fused-normal" in mode_str else 2
+            try:  # classic CGLS pays 5 small all-reduces per iteration
+                from pylops_mpi_tpu.solvers.ca import (
+                    classic_reductions_per_iter)
+                red_per_iter = classic_reductions_per_iter("cgls")
+            except Exception:
+                red_per_iter = 0.0
             cost = costmodel.OpCost(
                 flops=4.0 * nblock * nblock * nblk / nd,
                 hbm_bytes=sweeps * nblock * nblock * nblk * itemsize / nd,
-                ici_bytes=0.0, notes=("cgls.per_iteration",))
+                ici_bytes=0.0, notes=("cgls.per_iteration",),
+                reductions_per_iter=red_per_iter)
             if on_tpu:
                 peaks = costmodel.device_peaks(
                     jax.devices()[0],
@@ -1419,14 +1562,17 @@ def child_main():
                 except ValueError:
                     socket_gbps = 30.0
                 peaks = {"flops": None, "hbm_gbps": socket_gbps / nd,
-                         "ici_gbps": None}
+                         "ici_gbps": None,
+                         "allreduce_latency_s":
+                             costmodel.allreduce_latency_s("host")}
                 src = "assumed_cpu_stream"
             rl = costmodel.roofline(cost, peaks, n_dev=nd,
                                     measured_s=(1.0 / row_ips
                                                 if row_ips else None))
             out = {"bound": rl["bound"], "peak_source": src,
                    "flops_per_iter_dev": cost.flops,
-                   "hbm_bytes_per_iter_dev": cost.hbm_bytes}
+                   "hbm_bytes_per_iter_dev": cost.hbm_bytes,
+                   "reductions_per_iter": cost.reductions_per_iter}
             # measured-regime re-bucket (round 10): an implied
             # bandwidth above the HBM peak means VMEM residency, never
             # ">100% of HBM" (the round-5 misattribution)
@@ -1537,6 +1683,7 @@ def child_main():
         **({"spill_oversized": spill_race} if spill_race else {}),
         **({"precond": precond_race} if precond_race else {}),
         **({"sparse_vs_dense": sparse_race} if sparse_race else {}),
+        **({"ca_vs_classic": ca_race} if ca_race else {}),
         **({"selfcheck": selfcheck} if selfcheck is not None else {}),
         **({"cpu_breakdown": cpu_breakdown} if cpu_breakdown else {}),
     }
@@ -1751,7 +1898,8 @@ def _merge_tpu_cache(result, root=None):
                              "roofline", "f32", "bf16", "plan",
                              "spill", "tune_race", "batched", "serving",
                              "hierarchical_vs_flat", "spill_oversized",
-                             "precond", "sparse_vs_dense")
+                             "precond", "sparse_vs_dense",
+                             "ca_vs_classic")
                             if k in result}
                 result = dict(r)
                 result["cached"] = True
@@ -1791,6 +1939,12 @@ def _merge_tpu_cache(result, root=None):
                 if cpu_live.get("sparse_vs_dense") is not None:
                     result["sparse_vs_dense"] = \
                         cpu_live["sparse_vs_dense"]
+                # and the communication-avoiding race: live CPU-sim
+                # wall-speedup + HLO-pinned reduction counts that ride
+                # every compact line (round 17)
+                if cpu_live.get("ca_vs_classic") is not None:
+                    result["ca_vs_classic"] = \
+                        cpu_live["ca_vs_classic"]
                 result.setdefault("plan", "default")
                 # a legacy banked artifact predating the spill tier ran
                 # under the round-13 refusal semantics
@@ -2101,6 +2255,27 @@ def _sentinel_check(result, history, tolerance=0.15):
                               "regressed": srv_reg}
         if srv_reg:
             verdict.update(status="regressed", regressed=True)
+
+    # CA-solver sub-verdict (CA PR): the pipelined engine's
+    # latency-stalled solves/sec rides the same bucketed-median rule
+    # — the wall win the ca_vs_classic row measures must survive, not
+    # just exist once. Same stand-down rule as serving: no history
+    # with the number, no verdict.
+    def _ca_rate(row):
+        c = row.get("ca_vs_classic") or {}
+        v = c.get("solves_per_sec")
+        return float(v) if isinstance(v, (int, float)) and v > 0 else None
+    fresh_ca = _ca_rate(result)
+    hist_ca = [v for v in (_ca_rate(h) for h in rows) if v is not None]
+    if fresh_ca is not None and hist_ca:
+        base = statistics.median(hist_ca)
+        ca_reg = fresh_ca < base * (1.0 - tolerance)
+        verdict["ca"] = {"fresh": round(fresh_ca, 4),
+                         "baseline": round(base, 4),
+                         "ratio": round(fresh_ca / base, 4),
+                         "regressed": ca_reg}
+        if ca_reg:
+            verdict.update(status="regressed", regressed=True)
     return verdict
 
 
@@ -2262,6 +2437,18 @@ def _compact_line(result):
              "max_abs_diff") if sv.get(k) is not None}
     elif sv.get("error"):
         compact["sparse_vs_dense"] = {"error": sv["error"][:120]}
+    car = result.get("ca_vs_classic") or {}
+    if car and not car.get("error"):
+        compact["ca"] = {k: v for k, v in (
+            ("classic_iters", (car.get("classic") or {}).get("iters")),
+            ("pipelined_iters",
+             (car.get("pipelined") or {}).get("iters")),
+            ("reductions", car.get("reductions_per_iter")),
+            ("iters_parity", car.get("iters_parity")),
+            ("wall_speedup", car.get("wall_speedup")),
+        ) if v is not None}
+    elif car.get("error"):
+        compact["ca"] = {"error": car["error"][:120]}
     rl = result.get("roofline") or {}
     if rl and not rl.get("error"):
         compact["roofline"] = {

@@ -89,6 +89,10 @@ PAGES = {
          "pylops_mpi_tpu",
          ["block_cg", "block_cgls", "block_cg_segmented",
           "batched_solve", "batched_cache_info"]),
+        ("Communication-avoiding (pipelined / s-step)",
+         "pylops_mpi_tpu.solvers.ca",
+         ["resolve_mode", "ca_reductions_per_iter",
+          "classic_reductions_per_iter", "last_fallback"]),
         ("Eigenvalues", "pylops_mpi_tpu", ["power_iteration"]),
     ],
     "resilience": [

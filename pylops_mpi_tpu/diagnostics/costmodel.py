@@ -53,9 +53,9 @@ __all__ = ["OpCost", "estimate", "register_cost", "roofline",
            "summa_comm_volume", "summa_comm_volume_split",
            "pencil_transpose_cost",
            "peak_flops", "peak_hbm_gbps", "peak_ici_gbps",
-           "peak_dcn_gbps",
+           "peak_dcn_gbps", "allreduce_latency_s",
            "device_peaks", "PEAK_TFLOPS", "PEAK_HBM_GBPS",
-           "PEAK_ICI_GBPS", "PEAK_DCN_GBPS"]
+           "PEAK_ICI_GBPS", "PEAK_DCN_GBPS", "ALLREDUCE_LATENCY_S"]
 
 
 # ------------------------------------------------------------- peak tables
@@ -102,6 +102,29 @@ PEAK_DCN_GBPS = [
     ("v5p", 25.0), ("v5e", 6.25), ("v5 lite", 6.25), ("v5", 25.0),
     ("v4", 6.25), ("v3", 6.25), ("v2", 6.25),
 ]
+
+# APPROXIMATE per-fabric all-reduce LATENCY, seconds (round 17): the
+# α term of the α–β model, i.e. the floor one small (few-scalar)
+# all-reduce pays regardless of payload. A Krylov iteration's dot
+# products are exactly such reductions, so on DCN-connected pods the
+# iteration time is `max(apply, n_reductions * α)` — this is the term
+# the communication-avoiding tier (solvers/ca.py) exists to shrink,
+# and the selection signal its `auto` mode reads. Like the bandwidth
+# tables these are placement numbers (order-of-magnitude per fabric
+# class), not measurements: ICI ~ microseconds, DCN ~ tens of
+# microseconds per software-pipelined hop tree, `host` ~ the CPU-sim /
+# single-host dispatch floor.
+ALLREDUCE_LATENCY_S = {
+    "ici": 2e-6,
+    "dcn": 50e-6,
+    "host": 20e-6,
+}
+
+
+def allreduce_latency_s(fabric: str) -> Optional[float]:
+    """Per-fabric small-all-reduce latency floor (seconds); ``None``
+    for unknown fabric names rather than a wrong constant."""
+    return ALLREDUCE_LATENCY_S.get((fabric or "").strip().lower())
 
 
 def _lookup(table, device_kind: str) -> Optional[float]:
@@ -154,11 +177,13 @@ def device_peaks(device=None, mode: str = "bf16") -> Dict:
     if platform != "tpu":
         return {"flops": None, "hbm_gbps": None, "ici_gbps": None,
                 "dcn_gbps": None,
+                "allreduce_latency_s": allreduce_latency_s("host"),
                 "device_kind": kind, "platform": platform}
     return {"flops": peak_flops(kind, mode),
             "hbm_gbps": peak_hbm_gbps(kind),
             "ici_gbps": peak_ici_gbps(kind),
             "dcn_gbps": peak_dcn_gbps(kind),
+            "allreduce_latency_s": allreduce_latency_s("ici"),
             "device_kind": kind, "platform": platform}
 
 
@@ -175,30 +200,42 @@ class OpCost:
     meshes keep ``dcn_bytes == 0`` and every pre-round-11 model reads
     unchanged. ``dcn_bytes`` sits after ``notes`` so existing
     positional constructors keep their meaning. ``notes`` carries
-    model provenance (which registry entry, which schedule)."""
+    model provenance (which registry entry, which schedule).
+
+    ``reductions_per_iter`` (round 17, appended last for the same
+    positional-compat reason): how many latency-bound small
+    all-reduces the cost's unit of work issues — the count the
+    roofline's α-term ``latency`` component multiplies by the
+    per-fabric :data:`ALLREDUCE_LATENCY_S` constant. 0 (the default)
+    keeps every pre-round-17 model and roofline unchanged."""
 
     flops: float = 0.0
     hbm_bytes: float = 0.0
     ici_bytes: float = 0.0
     notes: Tuple[str, ...] = field(default_factory=tuple)
     dcn_bytes: float = 0.0
+    reductions_per_iter: float = 0.0
 
     def __add__(self, other: "OpCost") -> "OpCost":
         return OpCost(self.flops + other.flops,
                       self.hbm_bytes + other.hbm_bytes,
                       self.ici_bytes + other.ici_bytes,
                       self.notes + other.notes,
-                      self.dcn_bytes + other.dcn_bytes)
+                      self.dcn_bytes + other.dcn_bytes,
+                      self.reductions_per_iter
+                      + other.reductions_per_iter)
 
     def scaled(self, k: float) -> "OpCost":
         return OpCost(self.flops * k, self.hbm_bytes * k,
                       self.ici_bytes * k, self.notes,
-                      self.dcn_bytes * k)
+                      self.dcn_bytes * k, self.reductions_per_iter * k)
 
     def as_dict(self) -> Dict:
         return {"flops": self.flops, "hbm_bytes": self.hbm_bytes,
                 "ici_bytes": self.ici_bytes,
-                "dcn_bytes": self.dcn_bytes, "notes": list(self.notes)}
+                "dcn_bytes": self.dcn_bytes,
+                "reductions_per_iter": self.reductions_per_iter,
+                "notes": list(self.notes)}
 
 
 def _itemsize(dt) -> int:
@@ -628,8 +665,10 @@ def roofline(cost: OpCost, peaks: Dict, n_dev: int = 1,
              measured_s: Optional[float] = None) -> Dict:
     """Place an :class:`OpCost` on the roofline: per-component times
     (``flops / peak_flops``, ``hbm_bytes / hbm_bw``, ``ici_bytes /
-    ici_bw``, and — when the cost carries a hybrid-mesh split —
-    ``dcn_bytes / dcn_bw``; the cost is PER DEVICE, the peaks PER
+    ici_bw``, when the cost carries a hybrid-mesh split ``dcn_bytes /
+    dcn_bw``, and when it declares ``reductions_per_iter`` an α-term
+    ``latency`` component = reductions x the fabric's
+    ``allreduce_latency_s``; the cost is PER DEVICE, the peaks PER
     CHIP, so ``n_dev``
     only scales aggregate reporting), predicted seconds = max of the
     available components (a perfectly-overlapped execution's lower
@@ -655,6 +694,14 @@ def roofline(cost: OpCost, peaks: Dict, n_dev: int = 1,
         comps["ici"] = cost.ici_bytes / (peaks["ici_gbps"] * 1e9)
     if peaks.get("dcn_gbps") and cost.dcn_bytes:
         comps["dcn"] = cost.dcn_bytes / (peaks["dcn_gbps"] * 1e9)
+    # α-term (round 17): reductions pay a per-collective latency floor
+    # that no bandwidth component captures — a Krylov iteration's few
+    # scalar dots cost microseconds of wire time each, not bytes. Only
+    # costs that declare reductions_per_iter opt in, so every earlier
+    # roofline is unchanged.
+    if peaks.get("allreduce_latency_s") and cost.reductions_per_iter:
+        comps["latency"] = (cost.reductions_per_iter
+                            * peaks["allreduce_latency_s"])
     if not comps:
         return {"predicted_s": None, "bound": None, "components_s": {},
                 "cost": cost.as_dict(), "n_dev": n_dev}

@@ -83,9 +83,56 @@ __all__ = [
     "hier_chunked_pencil_transpose_planes",
     "hier_psum_scatter",
     "hier_all_gather",
+    "reduce_stall",
+    "stall_signature",
 ]
 
 _logger = logging.getLogger("pylops_mpi_tpu.collectives")
+
+
+# ------------------------------------------------ reduction-latency seam
+# The CPU-sim mesh has ~zero all-reduce latency, so the
+# communication-avoiding solver tier (solvers/ca.py) has nothing to win
+# against on CI: every reduction completes in the time of a local sum.
+# reduce_stall() is the bench/chaos seam that restores a pod-fabric
+# latency profile — it chains an N-step SERIAL scalar recurrence (each
+# step depends on the previous one, so XLA cannot parallelize or fold
+# it) onto a reduction result, seeded FROM that result (so it cannot be
+# hoisted as a loop invariant) and folded back in with a float ``*0``
+# term (which XLA must keep: 0*x is not 0 for NaN/inf operands). Every
+# consumer of the reduction therefore waits ~N serial FLOPs — a
+# deterministic, platform-independent stand-in for wire latency. With
+# the knob unset the input is returned untraced, keeping the solver
+# programs bit-identical.
+
+def reduce_stall(k, steps: Optional[int] = None):
+    """Chain an ``N``-step serial dependency onto reduction result
+    ``k`` (any float array) and return a value numerically equal to
+    ``k``. ``steps=None`` reads ``PYLOPS_MPI_TPU_REDUCE_STALL``; 0
+    returns ``k`` itself with nothing traced."""
+    if steps is None:
+        from ..utils import deps as _deps
+        steps = _deps.reduce_stall_steps()
+    if not steps:
+        return k
+    k = jnp.asarray(k)
+    seed = (jnp.sum(k) * jnp.asarray(1e-30, k.dtype)).astype(jnp.float32)
+
+    def _step(_, c):
+        return c * jnp.float32(1.0000001) + jnp.float32(1e-9)
+
+    z = lax.fori_loop(0, int(steps), _step, seed)
+    return k + (z * jnp.float32(0.0)).astype(k.dtype)
+
+
+def stall_signature() -> tuple:
+    """Fused-solver cache-key fragment for the stall seam: ``()`` when
+    off — so enabling the knob can never collide with (or perturb the
+    keys of) the bit-identical default programs — else a one-entry
+    tuple carrying the chain length."""
+    from ..utils import deps as _deps
+    n = _deps.reduce_stall_steps()
+    return (("stall", n),) if n else ()
 
 # ---------------------------------------------- per-op sequence numbers
 # Every rank of an SPMD job reaches the collectives in the same

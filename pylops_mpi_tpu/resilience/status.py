@@ -17,6 +17,10 @@ loops already hold — zero host callbacks, pinned by
   exits on the NEXT ``cond`` evaluation and the carry keeps the **last
   finite iterate**: the poisoned update is rejected with a
   ``jnp.where`` select, so ``resilient_solve`` can restart from it.
+  The s-step CA engine's monomial-basis conditioning guard
+  (solvers/ca.py) speaks the same word: a Gram-pivot breakdown sets
+  ``BREAKDOWN`` and the driver continues under the pipelined engine
+  from that last finite iterate.
 - ``STAGNATION`` — the best residual norm has not improved for
   ``PYLOPS_MPI_TPU_GUARD_STALL`` consecutive iterations (the
   machine-precision freeze documented in ``solvers/basic._mp_floor``

@@ -5,3 +5,4 @@ from .segmented import cg_segmented, cgls_segmented, SegmentedResult
 from .block import (block_cg, block_cgls, block_cg_segmented,
                     batched_solve, BatchedResult, batched_cache_info)
 from .eigs import power_iteration
+from . import ca

@@ -62,9 +62,17 @@ def _rdot(u, v):
     f32 or better even when the carry vectors are narrower — a bf16
     ``k/kold`` ratio is the recurrence contamination behind the round-5
     bf16 cliff (ops/_precision.py module doc). For ≥f32 carries this is
-    exactly the old ``_abs(u.dot(v.conj()))``."""
+    exactly the old ``_abs(u.dot(v.conj()))``.
+
+    The result passes through ``collectives.reduce_stall`` — a no-op
+    (nothing traced) unless the ``PYLOPS_MPI_TPU_REDUCE_STALL`` latency
+    seam is armed, in which case every reduction result drags an N-step
+    serial dependency chain: the bench's stand-in for per-collective
+    wire latency on a real fabric (docs/ca.md)."""
     from ..ops._precision import reduction_dtype
-    return _abs(u.dot(v.conj())).astype(reduction_dtype(_vdtype(u)))
+    from ..parallel.collectives import reduce_stall
+    return reduce_stall(
+        _abs(u.dot(v.conj())).astype(reduction_dtype(_vdtype(u))))
 
 
 def _step_scalar(s, carry_dtype):
@@ -774,8 +782,13 @@ def _get_fused(Op, key, make_builder, donate_argnums=(), keepalive=None):
     donate = tuple(donate_argnums) if donation_enabled() else ()
     # telemetry state is compile-relevant: a program traced with the
     # in-loop debug callbacks embedded must never be reused when the
-    # gate is off (and vice versa) — same pattern as the donation gate
-    key = key + (donate, telemetry.telemetry_signature())
+    # gate is off (and vice versa) — same pattern as the donation gate.
+    # So is the reduce_stall latency seam (it traces a scalar chain
+    # into every reduction); disarmed it contributes NOTHING, keeping
+    # pre-seam keys byte-identical.
+    from ..parallel.collectives import stall_signature
+    key = key + (donate, telemetry.telemetry_signature()) \
+        + stall_signature()
     entry = _FUSED_CACHE.get(key)
     if entry is None:
         if operator_is_jit_arg(Op):
@@ -813,7 +826,17 @@ def _run_cg_fused(Op, y: Vector, x0: Vector, x0_owned: bool, niter: int,
     build; the guard carries only exist under ``guards=True``).
     ``M=None`` leaves the cache key byte-identical to the pre-seam
     layout (``_mkey`` contributes nothing), so unpreconditioned solves
-    reuse existing entries."""
+    reuse existing entries.
+
+    ``PYLOPS_MPI_TPU_CA`` routes here: any mode but ``off`` dispatches
+    to the communication-avoiding tier (solvers/ca.py) under its own
+    cache keys; ``off`` takes the classic path below untouched — same
+    keys, same trace, bit-identical HLO (tests/test_ca.py)."""
+    from . import ca as _ca
+    _ca_mode = _ca.resolve_mode(Op, "cg")
+    if _ca_mode != "off":
+        return _ca.run_cg_fused(Op, y, x0, x0_owned, niter, tol,
+                                guards, M=M, mode=_ca_mode)
     if guards:
         from ..resilience import faults as _faults, status as _rstatus
         spec = _faults.consume()
@@ -913,7 +936,15 @@ def _run_cgls_fused(Op, y: Vector, x0: Vector, x0_owned: bool,
     """Compile-cache-and-run the fused CGLS loop; see
     :func:`_run_cg_fused` for the guard/status contract (including the
     ``M=None`` cache-key neutrality). Returns
-    ``(x, iiter, cost, cost1, kold, status_code_or_None)``."""
+    ``(x, iiter, cost, cost1, kold, status_code_or_None)``. Non-``off``
+    ``PYLOPS_MPI_TPU_CA`` modes dispatch to solvers/ca.py (whose CGLS
+    cost lanes carry normal-residual norms — docs/ca.md)."""
+    from . import ca as _ca
+    _ca_mode = _ca.resolve_mode(Op, "cgls")
+    if _ca_mode != "off":
+        return _ca.run_cgls_fused(Op, y, x0, x0_owned, niter, damp,
+                                  tol, use_normal, guards, M=M,
+                                  mode=_ca_mode)
     builder = _cgls_fused_normal if use_normal else _cgls_fused
     if guards:
         from ..resilience import faults as _faults, status as _rstatus

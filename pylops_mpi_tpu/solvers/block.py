@@ -59,10 +59,12 @@ __all__ = ["block_cg", "block_cgls", "block_cg_segmented",
 
 def _bdot(u: DistributedArray, v: DistributedArray):
     """Per-column recurrence dot at the policy reduction dtype — the
-    ``(K,)`` twin of ``solvers.basic._rdot``."""
+    ``(K,)`` twin of ``solvers.basic._rdot`` (including its
+    ``reduce_stall`` latency seam: no-op unless armed)."""
     from ..ops._precision import reduction_dtype
-    return jnp.abs(u.col_dot(v, vdot=True)).astype(
-        reduction_dtype(_vdtype(u)))
+    from ..parallel.collectives import reduce_stall
+    return reduce_stall(jnp.abs(u.col_dot(v, vdot=True)).astype(
+        reduction_dtype(_vdtype(u))))
 
 
 def _check_block(Op, y):
@@ -373,6 +375,14 @@ def block_cg(Op, y: DistributedArray,
             if use_guards:
                 _rstatus.record_columns("block_cg", [code], iiter)
             return _expand_col(x1), iiter, np.asarray(cost)[:, None]
+        from . import ca as _ca
+        _ca_mode = _ca.resolve_mode(Op, "block_cg")
+        if _ca_mode != "off":
+            # K>1 communication-avoiding route (s-step pipelines: no
+            # block Gram variant); K=1 already inherited CA above via
+            # the single-RHS runner's own dispatch
+            return _ca.run_block_cg(Op, y, x0, x0_owned, niter, tol,
+                                    use_guards, M=M, mode=_ca_mode)
         if use_guards:
             from ..resilience import status as _rstatus
             stall_n = _rstatus.stall_window()
@@ -438,6 +448,12 @@ def block_cgls(Op, y: DistributedArray,
             return (_expand_col(x1), istop, iiter, kold,
                     np.atleast_1d(np.asarray(cost1)[-1]),
                     np.asarray(cost)[:, None])
+        from . import ca as _ca
+        _ca_mode = _ca.resolve_mode(Op, "block_cgls")
+        if _ca_mode != "off":
+            return _ca.run_block_cgls(Op, y, x0, x0_owned, niter, damp,
+                                      tol, use_guards, M=M,
+                                      mode=_ca_mode)
         if use_guards:
             from ..resilience import status as _rstatus
             stall_n = _rstatus.stall_window()

@@ -273,13 +273,28 @@ def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
     # epoch loop is exactly what must prove liveness; no-op otherwise
     maybe_start_heartbeat()
     is_cgls = solver == "cgls"
-    fields = _CGLS_FIELDS if is_cgls else _CG_FIELDS
     guards_on, stall_n = _guard_params(guards)
     E = resolve_epoch(epoch, niter)
     if x0 is None:
         x0 = _zero_like_model(Op, y)
     mesh = y.mesh if isinstance(y, DistributedArray) else None
     damp2 = damp ** 2
+
+    # communication-avoiding tier (PYLOPS_MPI_TPU_CA, solvers/ca.py):
+    # the CA carries are different pytrees, stamped into the checkpoint
+    # meta so a resume under a different engine refuses. s-step is
+    # CG-only and needs the fused-Gram-eligible spaces; everything else
+    # downgrades to the pipelined engine.
+    from . import ca as _ca
+    from ..utils import deps as _deps
+    ca = _ca.resolve_mode(Op, solver)
+    if ca == "sstep" and (is_cgls or not _ca._sstep_eligible(y, x0)):
+        ca = "pipelined"
+    ca_s = _deps.ca_s_default() if ca == "sstep" else None
+    if ca == "off":
+        fields = _CGLS_FIELDS if is_cgls else _CG_FIELDS
+    else:
+        fields = _ca.seg_fields(solver, ca, M)
 
     meta = {"niter": niter, "tol": float(tol), "guards": guards_on,
             "precond": _precond_signature(M)}
@@ -290,6 +305,8 @@ def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
     else:
         state = (_load_carry(checkpoint_path, solver, mesh, meta)
                  if resume else None)
+    if state is not None:
+        _ca.check_resume_ca(state, ca, ca_s)
     resumed = state is not None
     # in-place elastic recovery: armed only under a supervisor that
     # assigned a reconfig file (or forced on); plain use stays inert
@@ -300,15 +317,42 @@ def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
                      epoch=E, guards=guards_on, resumed=resumed,
                      checkpoint=bool(checkpoint_path)):
         if state is None:
-            setup_builder = (_cgls_setup_builder if is_cgls
-                             else _cg_setup_builder)
+            if ca == "sstep":
+                def setup_builder(op, *, niter, M):
+                    return _ca.sstep_cg_setup_builder(op, niter=niter,
+                                                      M=M)
+            elif ca == "pipelined":
+                if is_cgls:
+                    def setup_builder(op, *, niter, M):
+                        return _ca.pipe_cgls_setup_builder(op,
+                                                           niter=niter,
+                                                           M=M)
+                else:
+                    def setup_builder(op, *, niter, M):
+                        return _ca.pipe_cg_setup_builder(op,
+                                                         niter=niter,
+                                                         M=M)
+            else:
+                setup_builder = (_cgls_setup_builder if is_cgls
+                                 else _cg_setup_builder)
             setup = _get_fused(Op, (id(Op), f"{solver}-seg-setup", niter,
-                                    _vkey(y), _vkey(x0)) + _mkey(M),
+                                    _vkey(y), _vkey(x0))
+                               + _ca.ca_key(ca, ca_s) + _mkey(M),
                                lambda op: setup_builder(op, niter=niter,
                                                         M=M),
                                keepalive=M)
             out = setup(y, x0, damp, damp2) if is_cgls else setup(y, x0)
-            if is_cgls:
+            if ca == "sstep":
+                nh = len(fields) - 6
+                kold, cost, floors = out[nh:]
+                vals = (list(out[:nh])
+                        + [kold, jnp.asarray(0), cost])
+            elif ca == "pipelined":
+                nh = len(fields) - 7
+                kold, aold, cost, floors = out[nh:]
+                vals = (list(out[:nh])
+                        + [kold, aold, jnp.asarray(0), cost])
+            elif is_cgls:
                 x, s, c, q, kold, cost, cost1, floors = out
                 vals = [x, s, c, q, kold, jnp.asarray(0), cost, cost1]
             else:
@@ -317,13 +361,31 @@ def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
             vals += [_i32(_rstatus.RUNNING), jnp.max(kold), _i32(0)]
             state = dict(zip(fields, vals))
             state["floors"] = floors
-        run_builder = (_cgls_epoch_builder if is_cgls
-                       else _cg_epoch_builder)
+        if ca == "sstep":
+            def run_builder(op, *, niter, guards, stall_n, M):
+                return _ca.sstep_cg_epoch_builder(op, s=ca_s,
+                                                  niter=niter,
+                                                  guards=guards,
+                                                  stall_n=stall_n, M=M)
+        elif ca == "pipelined":
+            if is_cgls:
+                def run_builder(op, *, niter, guards, stall_n, M):
+                    return _ca.pipe_cgls_epoch_builder(op, guards=guards,
+                                                       stall_n=stall_n,
+                                                       M=M)
+            else:
+                def run_builder(op, *, niter, guards, stall_n, M):
+                    return _ca.pipe_cg_epoch_builder(op, guards=guards,
+                                                     stall_n=stall_n,
+                                                     M=M)
+        else:
+            run_builder = (_cgls_epoch_builder if is_cgls
+                           else _cg_epoch_builder)
         run = _get_fused(Op, (id(Op), f"{solver}-seg", niter,
                               _vkey(y), _vkey(x0),
                               ("guards", guards_on,
                                stall_n if guards_on else None))
-                         + _mkey(M),
+                         + _ca.ca_key(ca, ca_s) + _mkey(M),
                          lambda op: run_builder(op, niter=niter,
                                                 guards=guards_on,
                                                 stall_n=stall_n, M=M),
@@ -354,7 +416,15 @@ def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
             state["floors"] = args[len(fields)]
             epochs += 1
             if ip_armed or checkpoint_path:
-                carry = {**meta, "epoch": E, "schema": _FUSED_SCHEMA}
+                carry = {**meta, "epoch": E,
+                         "schema": (_FUSED_SCHEMA if ca == "off"
+                                    else _ca.CA_SCHEMA)}
+                if ca != "off":
+                    # engine stamp: a resume under a different CA mode
+                    # (or s) refuses — the carries are different pytrees
+                    carry["ca"] = ca
+                    if ca == "sstep":
+                        carry["ca_s"] = int(ca_s)
                 carry.update({f: state[f] for f in fields})
                 carry["floors"] = state["floors"]
             if ip_armed:
@@ -381,9 +451,11 @@ def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
             _rstatus.record(solver, code, iiter)
         cost = np.asarray(state["cost"])[:iiter + 1]
         istop = 1 if code == _rstatus.CONVERGED else 2
-        if is_cgls:
+        if is_cgls and "cost1" in state:
             r2 = np.asarray(state["cost1"])[iiter]
         else:
+            # CA engines carry a single cost lane (sqrt of the
+            # preconditioned normal-residual norm for cgls)
             r2 = cost[-1] if len(cost) else None
         return SegmentedResult(
             x=state["x"], istop=istop, iiter=iiter,

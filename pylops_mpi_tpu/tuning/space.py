@@ -533,3 +533,64 @@ register_space(TuningSpace(
          "device_get/device_put stream and the double-buffer overlap "
          "choice (on = fetch of chunk k+1 rides behind the placement "
          "of chunk k); the budget stays the floor on chunk counts"))
+
+
+def _cost_ca(context: Dict, params: Dict) -> Optional[float]:
+    """Latency-aware (α–β) seed for the communication-avoiding solver
+    tier (solvers/ca.py): per-iteration time = operator-apply stream
+    term (β, bytes/bandwidth) + all-reduce count x per-fabric latency
+    floor (α, costmodel.ALLREDUCE_LATENCY_S). Classic CG pays 2
+    sequential reductions; the pipelined engine pays ONE, issued
+    before the apply so it hides behind it (max, not sum); s-step
+    pays 1/s reductions but (2s-1)/s applies for the combined basis
+    plus a conditioning-risk penalty growing with s."""
+    from ..diagnostics.costmodel import allreduce_latency_s
+    from ..solvers.ca import classic_reductions_per_iter
+    mode = params.get("mode", "off")
+    s = max(1, int(params.get("s", 1) or 1))
+    fabric = ("dcn" if _fabric_of(context)
+              else ("ici" if context.get("platform") == "tpu"
+                    else "host"))
+    lat = (allreduce_latency_s(fabric) or 0.0) + _dispatch_s(context)
+    extra = context.get("extra", {})
+    a_bytes = float(extra.get("a_bytes") or 0.0)
+    pk = _peaks(context)
+    nd = max(1, int(context.get("n_dev") or 1))
+    t_apply = (a_bytes / nd / (pk["hbm_gbps"] * 1e9)
+               if (a_bytes and pk.get("hbm_gbps")) else 0.0)
+    solver = str(extra.get("solver") or "cg")
+    try:
+        red = float(classic_reductions_per_iter(solver))
+    except KeyError:
+        red = 2.0
+    if mode == "off":
+        return t_apply + red * lat
+    if mode == "pipelined":
+        # one reduction in flight behind the apply; the extra vector
+        # recurrences add a small stream term
+        return max(t_apply, lat) + 0.05 * t_apply
+    # sstep: amortized latency, inflated basis work, breakdown risk
+    return (t_apply * (2.0 * s - 1.0) / s + lat / s
+            + 0.02 * (s - 1) * t_apply)
+
+
+def _enum_ca(context: Dict) -> List[Dict]:
+    """``s`` only varies under ``mode="sstep"`` — off/pipelined carry
+    the canonical ``s=1`` so the candidate list (and the measurement
+    budget) has no aliased trials."""
+    return ([{"mode": "off", "s": 1}, {"mode": "pipelined", "s": 1}]
+            + [{"mode": "sstep", "s": k} for k in (2, 4, 8)])
+
+
+register_space(TuningSpace(
+    op="ca",
+    axes=(Axis("mode", ("off", "pipelined", "sstep")),
+          Axis("s", (1, 2, 4, 8))),
+    cost=_cost_ca,
+    enumerate_fn=_enum_ca,
+    note="communication-avoiding Krylov engine selection "
+         "(solvers/ca.py): classic per-iteration reductions vs the "
+         "single-stacked-reduction pipelined engine vs the s-step "
+         "basis with one Gram reduction per s iterations; index 0 = "
+         "off keeps the bit-identity default, PYLOPS_MPI_TPU_CA "
+         "overrides any plan"))

@@ -313,6 +313,25 @@ KNOBS = [
      "precision-escalation restarts into narrow-inner-solve + "
      "wide-correction refinement passes instead of full wide "
      "re-solves"),
+    ("PYLOPS_MPI_TPU_CA", "off|pipelined|sstep|auto", "off",
+     "solvers/ca.py (solvers/basic.py, solvers/block.py, "
+     "solvers/segmented.py)",
+     "communication-avoiding Krylov tier: pipelined single-reduction "
+     "PCG/PCGLS, s-step Gram mode, or latency-aware auto selection "
+     "via the costmodel; off traces today's fused engines "
+     "bit-identically"),
+    ("PYLOPS_MPI_TPU_CA_S", "int>=2", "4",
+     "solvers/ca.py (tuning/space.py)",
+     "s-step depth of the CA solvers' Gram mode: one stacked "
+     "reduction per s iterations at the price of 2s-1 operator "
+     "applies; the monomial-basis conditioning guard falls back to "
+     "the pipelined engine on breakdown"),
+    ("PYLOPS_MPI_TPU_REDUCE_STALL", "int>=0", "unset (off)",
+     "parallel/collectives.py (solvers, bench.py)",
+     "bench/chaos seam: chain an N-step serial scalar dependency "
+     "onto every solver reduction result so the CPU sim becomes "
+     "latency-dominated like a pod fabric; unset/0 traces "
+     "bit-identical programs"),
 ]
 
 
@@ -376,6 +395,52 @@ def refine_enabled() -> bool:
     precision-escalation restarts run as iterative-refinement passes
     (narrow inner solve + wide correction, resilience/driver.py)."""
     return os.environ.get("PYLOPS_MPI_TPU_REFINE", "0") == "1"
+
+
+_warned_ca = False
+
+
+def ca_mode() -> str:
+    """``PYLOPS_MPI_TPU_CA`` resolved to ``off``/``pipelined``/
+    ``sstep``/``auto`` (unknown values fall back to ``off`` with a
+    one-time warning — a typo in a CI matrix must not silently swap
+    solver engines)."""
+    global _warned_ca
+    m = os.environ.get("PYLOPS_MPI_TPU_CA", "off").strip().lower()
+    if m in ("", "none", "default", "0", "classic"):
+        m = "off"
+    if m not in ("off", "pipelined", "sstep", "auto"):
+        if not _warned_ca:
+            import warnings
+            warnings.warn(
+                f"PYLOPS_MPI_TPU_CA={m!r} is not one of "
+                "['off', 'pipelined', 'sstep', 'auto']; using 'off'",
+                stacklevel=2)
+            _warned_ca = True
+        m = "off"
+    return m
+
+
+def ca_s_default() -> int:
+    """``PYLOPS_MPI_TPU_CA_S`` — s-step depth of the CA solvers' Gram
+    mode (floored at 2; a malformed value falls back to the default
+    rather than breaking the solve)."""
+    try:
+        v = int(os.environ.get("PYLOPS_MPI_TPU_CA_S", "4"))
+    except ValueError:
+        v = 4
+    return max(2, v)
+
+
+def reduce_stall_steps() -> int:
+    """``PYLOPS_MPI_TPU_REDUCE_STALL`` — serial-chain length appended
+    to every solver reduction result (0/unset = off, bit-identical
+    trace; malformed values are off rather than breaking the solve)."""
+    try:
+        v = int(os.environ.get("PYLOPS_MPI_TPU_REDUCE_STALL", "0"))
+    except ValueError:
+        v = 0
+    return max(0, v)
 
 
 _warned_overlap = False

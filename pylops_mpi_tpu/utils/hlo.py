@@ -32,7 +32,8 @@ __all__ = ["collective_report", "assert_no_full_gather",
            "assert_max_converts", "donation_report", "assert_donation",
            "count_collectives", "assert_ring_schedule",
            "host_callback_lines", "count_host_callbacks",
-           "assert_no_host_callbacks"]
+           "assert_no_host_callbacks", "while_body_computations",
+           "count_reductions", "assert_single_reduction"]
 
 # HLO opcode -> canonical name; bytes counted from the result shape
 _COLLECTIVE_OPS = ("all-gather", "all-reduce", "all-to-all",
@@ -453,3 +454,134 @@ def assert_no_full_gather(fn, *args, max_fraction: float = 0.5, **kwargs):
             f"bytes (> {max_fraction:.0%} of the {in_bytes}-byte "
             f"largest input): a sharded operand is being replicated")
     return report
+
+
+# ---------------------------------------------------------------------------
+# reduction counting — the communication-avoiding solver pins
+# ---------------------------------------------------------------------------
+#
+# The CA tier's whole contract is "exactly one all-reduce per solver
+# iteration" (solvers/ca.py). count_ops() cannot express that pin: the
+# reductions live inside the while-loop BODY computation, whose
+# XLA-assigned name carries no reliable substring, so the counter below
+# finds the body computations structurally — parse ``body=%name`` off
+# every ``while(`` instruction, then close transitively over every
+# computation those bodies call (fusions, to_apply reducers, nested
+# whiles, conditional branches).
+
+_HEADER_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\{\s*$")
+_CALLEE_RE = re.compile(
+    r"\b(?:calls|to_apply|body|condition|branch_computations|"
+    r"called_computations)=\{?%?([\w.\-]+(?:\}?,\s*%?[\w.\-]+)*)")
+_WHILE_BODY_RE = re.compile(r"\bwhile\((?:[^)]|\n)*?\)[^\n]*?body=%?([\w.\-]+)")
+
+
+def _computations(hlo: str) -> Dict[str, list]:
+    """``computation name -> its instruction lines`` (text level)."""
+    comps: Dict[str, list] = {}
+    cur = None
+    for line in hlo.splitlines():
+        hm = _HEADER_RE.match(line.strip())
+        if hm is not None:
+            cur = hm.group(1)
+            comps[cur] = []
+            continue
+        if cur is not None:
+            comps[cur].append(line)
+    return comps
+
+
+def _callees(lines: list) -> set:
+    """Names of every computation referenced by the given instruction
+    lines (``body=``/``condition=`` of nested whiles, ``to_apply=`` of
+    reduces, ``calls=`` of fusions, conditional branch lists)."""
+    out = set()
+    for line in lines:
+        for m in _CALLEE_RE.finditer(line):
+            for name in m.group(1).split(","):
+                out.add(name.strip().lstrip("%").rstrip("}"))
+    return out
+
+
+def while_body_computations(hlo: str) -> set:
+    """Names of every while-loop body computation in the module plus
+    everything those bodies transitively call. This is the scope the
+    per-iteration reduction pins count over — setup reductions (the
+    ``kold0`` dot outside the loop) must not leak into a
+    per-iteration count."""
+    comps = _computations(hlo)
+    roots = set()
+    for lines in comps.values():
+        for line in lines:
+            m = _WHILE_BODY_RE.search(line)
+            if m is not None:
+                roots.add(m.group(1))
+    # transitive closure over called computations
+    seen = set()
+    stack = list(roots)
+    while stack:
+        name = stack.pop()
+        if name in seen or name not in comps:
+            continue
+        seen.add(name)
+        stack.extend(_callees(comps[name]))
+    return seen
+
+
+_REDUCE_RE = re.compile(r"\ball-reduce(-start)?(?:\.\d+)?\(")
+
+
+def _count_reduce_lines(lines) -> int:
+    n = 0
+    for line in lines:
+        if "=" not in line:
+            continue
+        rhs = line.split("=", 1)[1]
+        m = _REDUCE_RE.search(rhs)
+        if m is not None and not (m.start() > 0
+                                  and rhs[m.start() - 1] == "%"):
+            n += 1
+    return n
+
+
+def count_reductions(hlo: str, scope: str = "body") -> int:
+    """Count ``all-reduce`` instructions in HLO text.
+
+    Counts sync ``all-reduce(`` and async ``all-reduce-start(`` once
+    each (``-done`` halves are skipped by construction). ``scope``:
+
+    - ``"body"`` (default): only instructions inside while-loop body
+      computations (transitively, via :func:`while_body_computations`)
+      — the per-iteration count the CA pins assert on;
+    - ``"all"``: the whole module, setup reductions included.
+    """
+    if scope == "all":
+        return _count_reduce_lines(hlo.splitlines())
+    if scope != "body":
+        raise ValueError(f"scope must be 'body' or 'all', got {scope!r}")
+    comps = _computations(hlo)
+    bodies = while_body_computations(hlo)
+    return sum(_count_reduce_lines(comps[name])
+               for name in bodies if name in comps)
+
+
+def assert_single_reduction(fn, *args, scope: str = "body",
+                            **kwargs) -> str:
+    """Compile ``fn(*args, **kwargs)`` and raise ``AssertionError``
+    unless the optimized HLO carries EXACTLY ONE all-reduce in
+    ``scope`` — the pipelined-solver pin: every per-iteration dot
+    product must have been merged into the single stacked reduction
+    (solvers/ca.py), because each extra all-reduce is one more
+    latency floor on the critical path. Returns the HLO text for
+    further checks."""
+    hlo = compiled_hlo(fn, *args, **kwargs)
+    n = count_reductions(hlo, scope=scope)
+    if n != 1:
+        lines = [ln.strip()[:160] for ln in hlo.splitlines()
+                 if _REDUCE_RE.search(ln)]
+        head = "\n".join(lines[:8])
+        raise AssertionError(
+            f"expected exactly 1 all-reduce in scope {scope!r}, found "
+            f"{n} — the stacked-reduction merge did not hold; "
+            f"all-reduce lines:\n{head}")
+    return hlo

@@ -284,9 +284,13 @@ def _fredholm_family(rng, B=3, nsl=8, nx=6, ny=6, nz=2):
     return factory, Gs, ys
 
 
-def test_batched_solve_matches_sequential(rng):
+def test_batched_solve_matches_sequential(rng, monkeypatch):
     """One vmapped compile solves the whole same-shape family to the
-    sequential per-problem answers."""
+    sequential per-problem answers. ``batched_solve`` stays on the
+    classic engines under any CA knob (documented composition limit,
+    docs/ca.md), so the sequential oracle must run classic too — force
+    the knob off for both sides."""
+    monkeypatch.setenv("PYLOPS_MPI_TPU_CA", "off")
     factory, Gs, ys = _fredholm_family(rng)
     res = batched_solve(factory, Gs, ys, solver="cgls", niter=15,
                         tol=0.0)
