@@ -297,6 +297,126 @@ def _serving_race_row(niter=20, n_requests=32):
         return {"error": repr(e)[:300]}
 
 
+def _aot_provenance():
+    """``aot=`` column for bench rows: how the bench process itself
+    ran — ``off`` (the default, bit-identical pre-AOT build), ``on``/
+    ``auto`` memory-only, or ``on+bank``/``auto+bank`` when an
+    executable bank directory is armed."""
+    try:
+        from pylops_mpi_tpu import aot
+        mode = aot.aot_mode()
+        if aot.aot_enabled() and aot.bank_dir():
+            return mode + "+bank"
+        return mode
+    except Exception:
+        return "off"
+
+
+# the cold-start child: one clean interpreter, one WarmPool prewarm,
+# one packed solve banked to disk for the parent's bit-identity check.
+# Mode/output dir arrive as argv; AOT knobs arrive via the environment
+# the parent composes per arm. Last stdout line is one JSON dict (the
+# _run_json_cmd salvage convention).
+_COLD_START_CHILD = r"""
+import json, os, sys, time
+import numpy as np
+mode, outdir = sys.argv[1], sys.argv[2]
+from pylops_mpi_tpu import MPIBlockDiag, aot
+from pylops_mpi_tpu.ops.local import MatrixMult
+from pylops_mpi_tpu.serving import FamilySpec, WarmPool
+nblk, nblock, niter = 8, 48, 10
+widths = (2, 4, 8)
+rng = np.random.default_rng(5)
+blocks = []
+for _ in range(nblk):
+    a = rng.standard_normal((nblock, nblock)).astype(np.float32)
+    blocks.append((a @ a.T / nblock
+                   + 2.0 * np.eye(nblock, dtype=np.float32))
+                  .astype(np.float32))
+Op = MPIBlockDiag([MatrixMult(b, dtype=np.float32) for b in blocks])
+pool = WarmPool(buckets=widths)
+pool.register(FamilySpec(name="cold", operator=Op, solver="cgls",
+                         niter=niter, tol=0.0))
+t0 = time.perf_counter()
+pool.prewarm(widths=list(widths))
+prewarm_s = time.perf_counter() - t0
+Y = rng.standard_normal((nblk * nblock, widths[-1])).astype(np.float32)
+out = pool.solve("cold", Y)
+np.save(os.path.join(outdir, "x_%s.npy" % mode), np.asarray(out.x))
+print(json.dumps({"mode": mode, "prewarm_s": prewarm_s,
+                  "compiles": aot.compile_count()}))
+"""
+
+
+def _cold_start_row():
+    """Cold-start race (AOT PR acceptance): daemon prewarm wall with a
+    COLD executable bank (compile + serialize) vs the SAME bank warm
+    (deserialize only), each arm a clean subprocess so jit caches and
+    import state never leak between them. A third ``AOT=off`` arm is
+    the bit-identity oracle: all three solve the same packed
+    block-CGLS system and the row asserts max-abs-diff 0.0 against it.
+    Acceptance bar: banked prewarm ≥ 3× faster than cold; the banked
+    arm must also replay with ZERO fresh compiles
+    (``aot.compile_count()``)."""
+    import shutil
+    import tempfile
+    tmp = tempfile.mkdtemp(prefix="bench_cold_start_")
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        bank = os.path.join(tmp, "bank")
+        budget = _stage_budget("cold_start", 240)
+
+        def _arm(mode):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = (here + os.pathsep
+                                 + env.get("PYTHONPATH", ""))
+            # a warm persistent compile cache (CI sets one for every
+            # pytest leg) must not contaminate the cold arm — every
+            # arm runs without it so the race measures the bank alone
+            env.pop("PYLOPS_MPI_TPU_COMPILE_CACHE", None)
+            if mode == "off":
+                env["PYLOPS_MPI_TPU_AOT"] = "off"
+                env.pop("PYLOPS_MPI_TPU_AOT_CACHE", None)
+            else:
+                env["PYLOPS_MPI_TPU_AOT"] = "on"
+                env["PYLOPS_MPI_TPU_AOT_CACHE"] = bank
+            return _run_json_cmd(
+                [sys.executable, "-c", _COLD_START_CHILD, mode, tmp],
+                env, budget, cwd=here)
+
+        arms = {}
+        for mode in ("cold", "banked", "off"):
+            got, err = _arm(mode)
+            if err or not isinstance(got, dict):
+                return {"error": f"{mode} arm: {err}"[:300]}
+            arms[mode] = got
+        import numpy as _np
+        xs = {m: _np.load(os.path.join(tmp, f"x_{m}.npy"))
+              for m in arms}
+        diff = max(float(_np.max(_np.abs(xs[m] - xs["off"])))
+                   for m in ("cold", "banked"))
+        t_cold = arms["cold"].get("prewarm_s")
+        t_bank = arms["banked"].get("prewarm_s")
+        speedup = (t_cold / t_bank if t_cold and t_bank else None)
+        return {"K_buckets": [2, 4, 8], "niter": 10,
+                "nblk": 8, "nblock": 48,
+                "cold_prewarm_s": _sig3(t_cold),
+                "banked_prewarm_s": _sig3(t_bank),
+                "speedup": _sig3(speedup),
+                "bar": 3.0,
+                "meets_bar": bool(speedup is not None
+                                  and speedup >= 3.0),
+                "cold_compiles": arms["cold"].get("compiles"),
+                "banked_compiles": arms["banked"].get("compiles"),
+                "zero_compile_replay":
+                    arms["banked"].get("compiles") == 0,
+                "max_abs_diff_vs_off": _sig3(diff)}
+    except Exception as e:  # the race must never cost the headline
+        return {"error": repr(e)[:300]}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _hier_race_row():
     """Hierarchical-vs-flat race (round 11 acceptance): declare the 8
     virtual devices a 2x4 hybrid fabric and run one pencil transpose
@@ -1519,6 +1639,16 @@ def child_main():
         _progress("CA race (classic vs pipelined CG, stalled reduce)")
         ca_race = _ca_race_row()
 
+    # cold-start race (AOT PR): daemon prewarm wall with a cold
+    # executable bank vs the same bank warm, bit-identity vs AOT=off;
+    # every CPU-sim round, BENCH_COLD_START_PYLOPS_MPI_TPU=1 forces
+    # it on hardware too
+    cold_start = None
+    cold_env = os.environ.get("BENCH_COLD_START_PYLOPS_MPI_TPU", "")
+    if cold_env != "0" and (not on_tpu or cold_env == "1"):
+        _progress("cold-start race (AOT bank: cold vs banked prewarm)")
+        cold_start = _cold_start_row()
+
     peak_bf16 = _peak_flops_per_chip(jax.devices()[0], "bf16")
     peak_f32 = _peak_flops_per_chip(jax.devices()[0], "f32_highest")
     peak_hbm = _peak_hbm_gbps(jax.devices()[0]) if on_tpu else None
@@ -1634,6 +1764,7 @@ def child_main():
         "vs_baseline": round(ips / cpu_ips, 2),
         "plan": plan_prov,  # tuned | costmodel | default (round 10)
         "spill": _spill_provenance(),  # auto | on | off (round 14)
+        "aot": _aot_provenance(),  # off | on | on+bank (round 18)
         # resilience stamps (ISSUE 6): headline solve exit status +
         # restart count (0 = single attempt, no resilient driver)
         "status": (b_status if (primary_bf16 and bf16_res is not None)
@@ -1684,6 +1815,7 @@ def child_main():
         **({"precond": precond_race} if precond_race else {}),
         **({"sparse_vs_dense": sparse_race} if sparse_race else {}),
         **({"ca_vs_classic": ca_race} if ca_race else {}),
+        **({"cold_start": cold_start} if cold_start else {}),
         **({"selfcheck": selfcheck} if selfcheck is not None else {}),
         **({"cpu_breakdown": cpu_breakdown} if cpu_breakdown else {}),
     }
@@ -1899,7 +2031,7 @@ def _merge_tpu_cache(result, root=None):
                              "spill", "tune_race", "batched", "serving",
                              "hierarchical_vs_flat", "spill_oversized",
                              "precond", "sparse_vs_dense",
-                             "ca_vs_classic")
+                             "ca_vs_classic", "cold_start", "aot")
                             if k in result}
                 result = dict(r)
                 result["cached"] = True
@@ -1945,7 +2077,17 @@ def _merge_tpu_cache(result, root=None):
                 if cpu_live.get("ca_vs_classic") is not None:
                     result["ca_vs_classic"] = \
                         cpu_live["ca_vs_classic"]
+                # and the cold-start race: live CPU-sim prewarm walls
+                # (cold vs banked AOT executable bank) that ride every
+                # compact line (round 18)
+                if cpu_live.get("cold_start") is not None:
+                    result["cold_start"] = cpu_live["cold_start"]
+                if cpu_live.get("aot") is not None:
+                    result["aot"] = cpu_live["aot"]
                 result.setdefault("plan", "default")
+                # a legacy banked artifact predating the AOT tier ran
+                # the pre-round-18 always-jit path
+                result.setdefault("aot", "off")
                 # a legacy banked artifact predating the spill tier ran
                 # under the round-13 refusal semantics
                 result.setdefault("spill", "off")
@@ -2276,6 +2418,34 @@ def _sentinel_check(result, history, tolerance=0.15):
                          "regressed": ca_reg}
         if ca_reg:
             verdict.update(status="regressed", regressed=True)
+
+    # cold-start sub-verdict (AOT PR): banked prewarm SECONDS ride the
+    # bucketed-median rule INVERTED — lower is better, so this trips
+    # when a fresh banked prewarm runs SLOWER than median × (1 + tol).
+    # Deserialize wall is millisecond-scale and jittery on a shared CI
+    # host, so the tolerance floors at 50% — the verdict exists to
+    # catch the bank silently degrading to recompile (a ~20×
+    # blow-up), not to police scheduler noise. Same stand-down rule as
+    # serving: rounds banked before the row existed carry no number,
+    # so no verdict until history accrues.
+    def _cold_secs(row):
+        c = row.get("cold_start") or {}
+        v = c.get("banked_prewarm_s")
+        return float(v) if isinstance(v, (int, float)) and v > 0 else None
+    fresh_cold = _cold_secs(result)
+    hist_cold = [v for v in (_cold_secs(h) for h in rows)
+                 if v is not None]
+    if fresh_cold is not None and hist_cold:
+        base = statistics.median(hist_cold)
+        cs_tol = max(tolerance, 0.5)
+        cs_reg = fresh_cold > base * (1.0 + cs_tol)
+        verdict["cold_start"] = {"fresh": round(fresh_cold, 4),
+                                 "baseline": round(base, 4),
+                                 "ratio": round(fresh_cold / base, 4),
+                                 "tolerance": cs_tol,
+                                 "regressed": cs_reg}
+        if cs_reg:
+            verdict.update(status="regressed", regressed=True)
     return verdict
 
 
@@ -2368,6 +2538,8 @@ def _compact_line(result):
         compact["plan"] = result["plan"]
     if result.get("spill"):
         compact["spill"] = result["spill"]
+    if result.get("aot"):
+        compact["aot"] = result["aot"]
     sr = result.get("spill_oversized") or {}
     if sr and not sr.get("error"):
         compact["spill_oversized"] = {
@@ -2449,6 +2621,15 @@ def _compact_line(result):
         ) if v is not None}
     elif car.get("error"):
         compact["ca"] = {"error": car["error"][:120]}
+    cs = result.get("cold_start") or {}
+    if cs and not cs.get("error"):
+        compact["cold_start"] = {
+            k: cs.get(k) for k in
+            ("cold_prewarm_s", "banked_prewarm_s", "speedup",
+             "meets_bar", "zero_compile_replay", "max_abs_diff_vs_off")
+            if cs.get(k) is not None}
+    elif cs.get("error"):
+        compact["cold_start"] = {"error": cs["error"][:120]}
     rl = result.get("roofline") or {}
     if rl and not rl.get("error"):
         compact["roofline"] = {

@@ -234,6 +234,19 @@ PAGES = {
          ["drain_timeout_s", "SolveDaemon", "worker_main",
           "serve_job"]),
     ],
+    "aot": [
+        ("AOT executable bank", "pylops_mpi_tpu.aot",
+         ["aot_mode", "aot_enabled", "bank_dir", "load_index",
+          "store_entry", "lookup", "rank_writes", "clear_memory"]),
+        ("Signatures", "pylops_mpi_tpu.aot",
+         ["compile_signature", "op_signature"]),
+        ("Serialization and replay", "pylops_mpi_tpu.aot",
+         ["AotExecutable", "serialize_compiled", "load_serialized",
+          "compile_count", "reset_compile_count"]),
+        ("Persistent compilation cache (fallback layer)",
+         "pylops_mpi_tpu.aot",
+         ["maybe_enable_compile_cache", "compile_cache_dir"]),
+    ],
     "models": [
         ("Model workflows", "pylops_mpi_tpu.models",
          ["PoststackLinearModelling", "MPIPoststackLinearModelling",
@@ -255,6 +268,7 @@ PAGE_TITLES = {
     "resilience": "Resilience and fault injection",
     "tuning": "Autotuning",
     "serving": "Serving (always-on solve service)",
+    "aot": "Ahead-of-time compile tier",
     "models": "Model workflows",
 }
 

@@ -63,5 +63,12 @@ from . import optimization
 from . import plotting
 from . import models
 from . import serving
+from . import aot
+
+# process-wide fallback compile tier: point JAX's persistent
+# compilation cache at PYLOPS_MPI_TPU_COMPILE_CACHE (no-op unset) so
+# every entry point — tests, bench, supervised workers, the serving
+# daemon — shares the job's cache without per-call wiring (docs/aot.md)
+aot.maybe_enable_compile_cache()
 
 __version__ = "0.1.0"

@@ -1,0 +1,51 @@
+"""Ahead-of-time compile tier: persistent executables for the fused
+solver programs.
+
+Every runtime tier so far still pays full XLA compile cost at process
+start — the serving WarmPool compiles each (family, K-bucket) at
+daemon boot, the tuner recompiles every candidate per trial, and a
+supervisor relaunch recompiles the whole solver on the recovery
+critical path. This package makes the compiled executable itself a
+persistent, cacheable artifact the same way ``tuning/cache.py`` made
+schedules one:
+
+- :mod:`~pylops_mpi_tpu.aot.executable` — lower the fused program
+  once, serialize the compiled executable via
+  ``jax.experimental.serialize_executable`` (PJRT executable
+  serialization), and replay it through the flat-call path on the
+  next process start;
+- :mod:`~pylops_mpi_tpu.aot.store` — a schema-versioned atomic
+  on-disk bank keyed like the plan cache plus the compile-relevant
+  signature (jax version, backend/chip kind, mesh size, topology key,
+  dtype/precision, guard/CA/telemetry knob states). Corrupt,
+  truncated, or signature-mismatched entries fall back to fresh
+  compile with a traced ``aot.cache_error`` event;
+- :mod:`~pylops_mpi_tpu.aot.compile_cache` — JAX's persistent
+  compilation cache (``PYLOPS_MPI_TPU_COMPILE_CACHE``) as the
+  fallback layer for programs we don't explicitly serialize
+  (closure-captured operators, preconditioned solves, ISTA/FISTA).
+
+``PYLOPS_MPI_TPU_AOT=off`` (the default) is bit-identical to the
+pre-AOT build: the seam in ``solvers/basic.py:_get_fused`` contributes
+nothing to the traced program or its cache keys (pinned by
+tests/test_aot.py). See docs/aot.md.
+"""
+
+from .store import (SCHEMA_VERSION, aot_mode, aot_enabled, bank_dir,
+                    clear_memory, load_index, store_entry, lookup,
+                    rank_writes)
+from .signature import compile_signature, op_signature
+from .executable import (AotExecutable, compile_count,
+                         reset_compile_count, serialize_compiled,
+                         load_serialized, maybe_aot_fused)
+from .compile_cache import (maybe_enable_compile_cache,
+                            compile_cache_dir)
+
+__all__ = [
+    "SCHEMA_VERSION", "aot_mode", "aot_enabled", "bank_dir",
+    "clear_memory", "load_index", "store_entry", "lookup",
+    "rank_writes", "compile_signature", "op_signature",
+    "AotExecutable", "compile_count", "reset_compile_count",
+    "serialize_compiled", "load_serialized", "maybe_aot_fused",
+    "maybe_enable_compile_cache", "compile_cache_dir",
+]

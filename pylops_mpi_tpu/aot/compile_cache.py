@@ -1,0 +1,82 @@
+"""JAX persistent compilation cache wiring — the fallback layer.
+
+The executable bank (:mod:`~pylops_mpi_tpu.aot.store`) serializes
+only the programs whose operators enter as jit arguments; everything
+else — closure-captured operators, preconditioned solves, ISTA/FISTA,
+one-off jits across the package — still pays XLA compile on first
+trace. ``PYLOPS_MPI_TPU_COMPILE_CACHE=<dir>`` points JAX's own
+persistent compilation cache at a shared directory so those compiles
+are paid once per (program, jax version, backend) ACROSS processes:
+CI legs share a per-job dir, the tier-1 command keeps one under
+``/tmp``, and a supervisor relaunch re-traces but does not re-optimize.
+
+Multi-host contract: rank 0 writes, other ranks read — every rank
+lowers the same SPMD program, so one writer suffices and NFS cache
+dirs see no cross-rank write races. Non-zero ranks get the read-only
+behavior by an effectively-infinite ``min_compile_time`` floor (JAX
+has no explicit read-only switch; a cache write only happens for
+compiles slower than the floor).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from ..diagnostics import trace as _trace
+from .store import rank_writes
+
+__all__ = ["compile_cache_dir", "maybe_enable_compile_cache"]
+
+_LOCK = threading.Lock()
+_enabled_dir: Optional[str] = None
+
+
+def compile_cache_dir() -> Optional[str]:
+    """``PYLOPS_MPI_TPU_COMPILE_CACHE`` (a directory), or ``None``."""
+    return os.environ.get("PYLOPS_MPI_TPU_COMPILE_CACHE") or None
+
+
+def maybe_enable_compile_cache(path: Optional[str] = None
+                               ) -> Optional[str]:
+    """Point ``jax_compilation_cache_dir`` at the configured directory
+    (idempotent; process-wide). Called at package import so every
+    entry point — tests, bench, workers, the serving daemon — shares
+    the job's cache without per-call wiring. Returns the enabled dir
+    or ``None`` (unset env, or jax too old to have the knobs — a
+    config failure is traced and swallowed, never fatal)."""
+    global _enabled_dir
+    path = path or compile_cache_dir()
+    if not path:
+        return None
+    with _LOCK:
+        if _enabled_dir == path:
+            return path
+        try:
+            import jax
+            jax.config.update("jax_compilation_cache_dir", path)
+            if rank_writes():
+                # bank every compile, however fast: CPU-sim programs
+                # compile in ms and the defaults would skip them all
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0)
+                try:
+                    jax.config.update(
+                        "jax_persistent_cache_min_entry_size_bytes", 0)
+                except Exception:
+                    pass  # knob landed after the min-time one
+            else:
+                # read-only rank: reads always hit; a write would need
+                # a compile slower than this floor
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs",
+                    1e9)
+            _enabled_dir = path
+            _trace.event("aot.compile_cache", cat="aot", path=path,
+                         writer=rank_writes())
+            return path
+        except Exception as e:
+            _trace.event("aot.cache_error", cat="aot", path=path,
+                         why=f"compile cache enable failed: {e!r}")
+            return None

@@ -1,0 +1,226 @@
+"""Serialize, load, and replay compiled fused-solver executables.
+
+The replay path is FLAT CALL, not ``Compiled.__call__``: a jitted
+program whose operator enters as a pytree argument stores a shallow
+copy of that operator inside its input treedef, and treedef equality
+on operator aux data is identity-based — so ``Compiled.__call__``
+rejects even the in-process round trip. Instead we flatten the live
+operands ourselves, invoke the loaded ``MeshExecutable`` directly,
+and unflatten through the banked OUTPUT treedef (whose aux data —
+meshes, shardings — serializes fine through the PJRT pickler's device
+hooks). The executable re-validates operand avals on every call, so a
+stale banked program can raise but never silently compute the wrong
+thing; any such raise falls back to a fresh compile.
+
+``compile_count()`` counts fresh XLA compiles performed by this seam —
+the CI ``test-aot`` leg pins it to ZERO on a replay run against a
+seeded bank.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import threading
+import time
+from typing import Any, Optional, Tuple
+
+from ..diagnostics import metrics as _metrics
+from ..diagnostics import trace as _trace
+from . import signature as _sig
+from . import store as _store
+
+__all__ = ["AotExecutable", "compile_count", "reset_compile_count",
+           "serialize_compiled", "load_serialized", "maybe_aot_fused"]
+
+_COUNT_LOCK = threading.Lock()
+_COMPILE_COUNT = 0
+
+
+def compile_count() -> int:
+    """Fresh XLA compiles performed by the AOT seam in this process
+    (bank loads do NOT count — that is the point)."""
+    return _COMPILE_COUNT
+
+
+def reset_compile_count() -> None:
+    global _COMPILE_COUNT
+    with _COUNT_LOCK:
+        _COMPILE_COUNT = 0
+
+
+def _bump_compiles() -> None:
+    global _COMPILE_COUNT
+    with _COUNT_LOCK:
+        _COMPILE_COUNT += 1
+    _metrics.inc("aot.compiles")
+
+
+class AotExecutable:
+    """A loaded executable plus the banked output treedef. ``banked``
+    records provenance (``True`` = deserialized from the bank, eligible
+    for the stale-program fallback; ``False`` = freshly compiled in
+    this process)."""
+
+    __slots__ = ("exe", "out_tree", "banked")
+
+    def __init__(self, exe, out_tree, banked: bool):
+        self.exe = exe
+        self.out_tree = out_tree
+        self.banked = banked
+
+    def call(self, args: Tuple):
+        """Flat-call ``args`` (the FULL jit operand tuple, operator
+        included) and unflatten through the banked output treedef."""
+        import jax
+        flat, _ = jax.tree_util.tree_flatten((tuple(args), {}))
+        out_flat = self.exe.call(*flat)
+        return jax.tree_util.tree_unflatten(self.out_tree, out_flat)
+
+
+def serialize_compiled(compiled) -> Tuple[bytes, bytes]:
+    """``(payload, out_tree_bytes)`` for a ``jax.stages.Compiled``.
+    The payload is PJRT executable serialization
+    (``jax.experimental.serialize_executable``); the output treedef is
+    pickled through the same device-aware pickler (its aux data holds
+    meshes/shardings, which plain pickle rejects)."""
+    from jax.experimental import serialize_executable as se
+    payload, _in_tree, out_tree = se.serialize(compiled)
+    buf = io.BytesIO()
+    se._JaxPjrtPickler(buf, protocol=pickle.HIGHEST_PROTOCOL).dump(
+        out_tree)
+    return payload, buf.getvalue()
+
+
+def load_serialized(payload: bytes, out_tree_bytes: bytes
+                    ) -> AotExecutable:
+    """Deserialize a banked payload into a live ``MeshExecutable`` on
+    this process's backend. Raises on any mismatch — the caller
+    classifies the failure and falls back to fresh compile."""
+    import jax
+    from jax.experimental import serialize_executable as se
+    backend = jax.devices()[0].client
+    unloaded, _args_info, _kwargs = se._JaxPjrtUnpickler(
+        io.BytesIO(payload), backend).load()
+    exe = unloaded.load()
+    out_tree = se._JaxPjrtUnpickler(io.BytesIO(out_tree_bytes),
+                                    backend).load()
+    return AotExecutable(exe, out_tree, banked=True)
+
+
+class _AotFused:
+    """The callable ``_get_fused`` returns on the AOT path for a
+    jit-argument operator: resolves its executable lazily on first
+    call (memory tier → disk bank → fresh compile), then flat-calls
+    it. Matches the off-path calling convention exactly — invoked with
+    the runtime operands only, the operator bound at construction."""
+
+    def __init__(self, jfn, op, bank_key: Tuple):
+        self._jfn = jfn
+        self._op = op
+        self._bank_key = bank_key
+        self._exe: Optional[AotExecutable] = None
+
+    def __call__(self, *operands):
+        args = (self._op,) + operands
+        if self._exe is None:
+            self._exe = _resolve(self._jfn, self._bank_key, args)
+        try:
+            return self._exe.call(args)
+        except Exception as e:
+            if not self._exe.banked:
+                raise
+            # a banked program this environment cannot actually run
+            # (the executable's own aval fence) — never serve it;
+            # recompile fresh and retry once. The failed call
+            # validated avals before executing, so no operand buffer
+            # was consumed.
+            _trace.event("aot.cache_error", cat="aot",
+                         path=str(_store.bank_dir() or "<memory>"),
+                         why=f"banked executable rejected at call "
+                             f"time: {e!r}")
+            self._exe = _fresh_compile(self._jfn, self._bank_key, args)
+            return self._exe.call(args)
+
+
+def _resolve(jfn, bank_key: Tuple, args: Tuple) -> AotExecutable:
+    """Memory tier → disk bank → fresh compile, with classified
+    hit/miss metrics at each step."""
+    mem = _store.mem_get(bank_key)
+    if mem is not None:
+        _metrics.inc("aot.cache.hit")
+        _trace.event("aot.hit", cat="aot", tier="memory")
+        return mem
+    sig = _sig.compile_signature()
+    avals = _sig.args_avals(args)
+    banked = _store.lookup(bank_key, sig, avals)
+    if banked is not None:
+        payload, out_tree_bytes, entry = banked
+        t0 = time.perf_counter()
+        try:
+            exe = load_serialized(payload, out_tree_bytes)
+        except Exception as e:  # undeserializable blob: classified miss
+            _store._cache_error(str(_store.bank_dir() or "<memory>"),
+                                f"deserialize failed: {e!r}")
+        else:
+            load_s = time.perf_counter() - t0
+            _metrics.inc("aot.cache.hit")
+            _metrics.observe("aot.load_s", load_s)
+            _trace.event("aot.hit", cat="aot", tier="disk",
+                         load_s=round(load_s, 4),
+                         compile_s_saved=entry.get("compile_s"))
+            _store.mem_put(bank_key, exe)
+            return exe
+    _metrics.inc("aot.cache.miss")
+    return _fresh_compile(jfn, bank_key, args)
+
+
+def _fresh_compile(jfn, bank_key: Tuple, args: Tuple) -> AotExecutable:
+    """Lower+compile the fused program explicitly (so the executable
+    object is ours to serialize), bank it best-effort, and return it
+    for flat-call replay."""
+    t0 = time.perf_counter()
+    compiled = jfn.lower(*args).compile()
+    compile_s = time.perf_counter() - t0
+    _bump_compiles()
+    _metrics.observe("aot.compile_s", compile_s)
+    _trace.event("aot.compile", cat="aot",
+                 compile_s=round(compile_s, 4))
+    out_tree = None
+    try:
+        payload, out_tree_bytes = serialize_compiled(compiled)
+        # Store-time round-trip fence: an executable XLA itself served
+        # from its persistent compilation cache can serialize into a
+        # payload that does NOT deserialize ("Symbols not found" on the
+        # CPU backend). Verify before banking so no later process has
+        # to fail the deserialize first and fall back every cold start.
+        out_tree = load_serialized(payload, out_tree_bytes).out_tree
+        _store.store_entry(bank_key, _sig.compile_signature(),
+                           _sig.args_avals(args), payload,
+                           out_tree_bytes, compile_s)
+    except Exception as e:  # serialization is best-effort
+        _trace.event("aot.cache_error", cat="aot",
+                     path=str(_store.bank_dir() or "<memory>"),
+                     why=f"serialize/round-trip failed; not banked: "
+                         f"{e!r}")
+    if out_tree is None:
+        # fall back to flattening a throwaway jaxpr-free structure:
+        # the Compiled wrapper knows its own output treedef
+        out_tree = compiled.out_tree
+    exe = AotExecutable(compiled._executable, out_tree, banked=False)
+    _store.mem_put(bank_key, exe)
+    return exe
+
+
+def maybe_aot_fused(jfn, op, key: Tuple) -> Optional[Any]:
+    """The seam ``solvers/basic.py:_get_fused`` calls on the
+    jit-argument branch. Returns an ``_AotFused`` callable when the
+    AOT tier is armed, else ``None`` (the off path — bit-identical to
+    the pre-AOT build). ``key`` is the fused-cache key whose first
+    element is ``id(op)``; the bank key replaces it with the
+    structural :func:`~pylops_mpi_tpu.aot.signature.op_signature` so a
+    fresh process (new instance, same program) can hit."""
+    if not _store.aot_enabled():
+        return None
+    bank_key = (_sig.op_signature(op),) + tuple(key[1:])
+    return _AotFused(jfn, op, bank_key)

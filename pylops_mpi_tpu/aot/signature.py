@@ -1,0 +1,102 @@
+"""Compile-relevant signatures for the AOT executable bank.
+
+A serialized executable is only valid in an environment that would
+have produced the same lowered program: same jax version, same
+backend/chip kind, same device count, same precision pins, same
+compile-relevant knob states. The bank stores
+:func:`compile_signature` next to every entry and the loader compares
+field-by-field — ANY mismatch is a classified miss that falls back to
+fresh compile (never a crash, never a stale program). The operator
+itself enters the key through :func:`op_signature`, a structural
+fingerprint that survives process restarts (``id(Op)`` — the in-memory
+fused-cache key — does not).
+"""
+
+import os
+from typing import Any, Dict, Tuple
+
+# Env knobs whose value changes the TRACED fused program (directly or
+# through the builders _get_fused wraps). Guards/telemetry/stall/
+# donation state already ride the fused-cache key itself; these are
+# the ambient ones a key built in another process could silently
+# disagree on.
+_COMPILE_KNOBS = (
+    "PYLOPS_MPI_TPU_X64",
+    "PYLOPS_MPI_TPU_MATMUL_PRECISION",
+    "PYLOPS_MPI_TPU_EXPLICIT_STENCIL",
+    "PYLOPS_MPI_TPU_OVERLAP",
+    "PYLOPS_MPI_TPU_COMM_CHUNKS",
+    "PYLOPS_MPI_TPU_HIERARCHICAL",
+    "PYLOPS_MPI_TPU_FABRIC",
+    "PYLOPS_MPI_TPU_CA",
+    "PYLOPS_MPI_TPU_CA_S",
+)
+
+
+def compile_signature() -> Dict[str, Any]:
+    """The environment fingerprint stored with (and checked against)
+    every banked executable. Keys are plain JSON scalars so the
+    signature round-trips through the index file unchanged."""
+    import jax
+    dev = jax.devices()[0]
+    return {
+        "jax": jax.__version__,
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+        "n_devices": jax.device_count(),
+        "n_processes": int(os.environ.get(
+            "PYLOPS_MPI_TPU_NUM_PROCESSES", "1") or "1"),
+        "x64": bool(jax.config.jax_enable_x64),
+        "topology": _topology_key(),
+        "knobs": {k: os.environ.get(k, "") for k in _COMPILE_KNOBS},
+    }
+
+
+def _topology_key() -> str:
+    """The fabric topology key when the mesh module can produce one
+    (hybrid dcn x ici classification), else the flat device count."""
+    try:
+        import jax
+        from ..parallel.topology import topology_key
+        from ..parallel.mesh import default_mesh
+        return str(topology_key(default_mesh()))
+    except Exception:
+        try:
+            import jax
+            return f"flat{jax.device_count()}"
+        except Exception:
+            return "unknown"
+
+
+def op_signature(Op) -> Tuple:
+    """Structural fingerprint of a jit-argument operator: class name,
+    logical shape/dtype, and the avals of its registered device-buffer
+    leaves. Two operator INSTANCES with the same signature lower to
+    the same program (their buffers are runtime arguments, not baked
+    constants), which is exactly what lets a fresh process reuse an
+    executable banked by a dead one. Operators may override with an
+    ``aot_signature()`` method when structure alone under-determines
+    the trace."""
+    hook = getattr(Op, "aot_signature", None)
+    if callable(hook):
+        return ("custom", type(Op).__name__, tuple(hook()))
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(Op)
+    avals = tuple(
+        (tuple(getattr(leaf, "shape", ())), str(getattr(leaf, "dtype",
+                                                        type(leaf))))
+        for leaf in leaves)
+    return (type(Op).__name__, tuple(Op.shape), str(Op.dtype), avals)
+
+
+def args_avals(args) -> Tuple:
+    """Shape/dtype fingerprint of the flat runtime operands — banked
+    next to the signature so a key collision across differently-shaped
+    problems is caught BEFORE deserialization (the executable's own
+    aval check at call time is the second fence)."""
+    import jax
+    flat, _ = jax.tree_util.tree_flatten((tuple(args), {}))
+    return tuple(
+        (tuple(getattr(leaf, "shape", ())), str(getattr(leaf, "dtype",
+                                                        type(leaf))))
+        for leaf in flat)

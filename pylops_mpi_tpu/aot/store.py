@@ -1,0 +1,335 @@
+"""Schema-versioned atomic on-disk bank for serialized executables.
+
+Layout (``PYLOPS_MPI_TPU_AOT_CACHE`` names the directory):
+
+- ``index.json`` — ``{"schema": N, "entries": {entry_id: {"key":
+  <repr of the bank key>, "signature": <compile_signature dict>,
+  "avals": <args fingerprint>, "payload": "exe_<id>.bin",
+  "compile_s": wall, "nbytes": payload size, "created_s": epoch}}}``.
+  Written read-merge-atomic (temp file + ``os.replace``) under an
+  ``fcntl.flock`` sidecar — the plan-cache discipline
+  (``tuning/cache.py``), so two processes banking concurrently merge
+  instead of clobbering.
+- ``exe_<id>.bin`` — one pickled container per entry:
+  ``{"payload": <PJRT serialized executable bytes>, "out_tree":
+  <pickled output treedef>}``. Written first, indexed second, so a
+  crash between the two leaves an orphaned blob, never a dangling
+  index row.
+
+Every failure mode — unreadable index, schema mismatch, missing or
+truncated payload, signature/aval mismatch — is a CLASSIFIED miss: a
+``aot.cache_error`` trace event (plus ``aot.cache.miss``) and a fresh
+compile. The bank can never take the workload down and can never
+serve a stale program (the loaded executable additionally re-validates
+operand avals at call time).
+
+Multi-host contract: only rank 0 (``PYLOPS_MPI_TPU_PROCESS_ID`` unset
+or ``0``) writes the bank; other ranks read it. Every rank lowers the
+same SPMD program, so one writer suffices and NFS-backed cache dirs
+see no cross-rank write races (docs/aot.md#multi-host).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import tempfile
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from ..diagnostics import trace as _trace
+
+__all__ = ["SCHEMA_VERSION", "aot_mode", "aot_enabled", "bank_dir",
+           "rank_writes", "entry_id", "load_index", "lookup",
+           "store_entry", "clear_memory"]
+
+SCHEMA_VERSION = 1
+_AOT_MODES = ("auto", "on", "off")
+
+_LOCK = threading.Lock()
+# process-local tier: bank_key -> loaded AotExecutable. Always
+# consulted first; the ONLY tier under AOT=on with no cache dir
+# (memory-only — nothing is written to disk behind the user's back,
+# mirroring the TUNE/TUNE_CACHE split).
+_MEM: Dict[Tuple, Any] = {}
+_warned_corrupt = False
+_warned_mode = False
+
+
+def aot_mode() -> str:
+    """``PYLOPS_MPI_TPU_AOT`` resolved to ``auto``/``on``/``off``
+    (default ``off`` — the seam must be bit-identical to the pre-AOT
+    build unless asked for; unknown values warn once and fall back,
+    the watchdog-knob rule)."""
+    global _warned_mode
+    m = os.environ.get("PYLOPS_MPI_TPU_AOT", "off").strip().lower()
+    if m in ("", "none", "default", "0"):
+        m = "off"
+    if m == "1":
+        m = "on"
+    if m not in _AOT_MODES:
+        if not _warned_mode:
+            import warnings
+            warnings.warn(f"PYLOPS_MPI_TPU_AOT={m!r} is not one of "
+                          f"{_AOT_MODES}; using 'off'", stacklevel=2)
+            _warned_mode = True
+        m = "off"
+    return m
+
+
+def aot_enabled() -> bool:
+    """``on`` → armed (memory-only without a cache dir); ``off`` →
+    disarmed; ``auto`` → armed only when ``PYLOPS_MPI_TPU_AOT_CACHE``
+    names a bank directory."""
+    m = aot_mode()
+    if m == "on":
+        return True
+    if m == "off":
+        return False
+    return bank_dir() is not None
+
+
+def bank_dir(path: Optional[str] = None) -> Optional[str]:
+    """Resolved bank directory: the explicit argument, else
+    ``PYLOPS_MPI_TPU_AOT_CACHE``, else ``None`` (memory-only)."""
+    if path:
+        return path
+    return os.environ.get("PYLOPS_MPI_TPU_AOT_CACHE") or None
+
+
+def rank_writes() -> bool:
+    """Whether THIS process may write the bank: rank 0 of the elastic
+    contract, or any single-process run. Non-zero ranks lower the same
+    SPMD program — they read the bank rank 0 populates."""
+    rid = os.environ.get("PYLOPS_MPI_TPU_PROCESS_ID", "0") or "0"
+    try:
+        return int(rid) == 0
+    except ValueError:
+        return True
+
+
+def entry_id(key: Tuple) -> str:
+    """Stable filename-safe id for a bank key (sha256 of its repr —
+    the key is built from plain values whose repr is deterministic:
+    strings, ints, bools, dtypes, nested tuples)."""
+    return hashlib.sha256(repr(key).encode()).hexdigest()[:32]
+
+
+def _cache_error(where: str, why: str) -> None:
+    """One structured ``aot.cache_error`` event + one-time warning per
+    corrupt/mismatched bank; the caller proceeds with a fresh compile
+    — never an exception, never a stale program."""
+    global _warned_corrupt
+    _trace.event("aot.cache_error", cat="aot", path=where, why=why)
+    if not _warned_corrupt:
+        import warnings
+        warnings.warn(
+            f"pylops_mpi_tpu AOT bank {where!r} unusable ({why}); "
+            "falling back to fresh compiles", stacklevel=3)
+        _warned_corrupt = True
+
+
+def load_index(dirpath: Optional[str] = None) -> Dict[str, dict]:
+    """Entry table from ``index.json`` (``{}`` when unset/missing/
+    corrupt/version-mismatched — every failure mode is a logged
+    miss)."""
+    dirpath = bank_dir(dirpath)
+    if not dirpath:
+        return {}
+    path = os.path.join(dirpath, "index.json")
+    if not os.path.exists(path):
+        return {}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        _cache_error(path, f"unreadable: {e!r}")
+        return {}
+    if not isinstance(doc, dict):
+        _cache_error(path, "not a JSON object")
+        return {}
+    if doc.get("schema") != SCHEMA_VERSION:
+        _cache_error(path, f"schema {doc.get('schema')!r} != "
+                           f"{SCHEMA_VERSION}")
+        return {}
+    entries = doc.get("entries")
+    if not isinstance(entries, dict):
+        _cache_error(path, "missing 'entries' table")
+        return {}
+    return {str(k): v for k, v in entries.items()
+            if isinstance(v, dict)}
+
+
+def _signature_mismatch(banked: dict, live: dict) -> Optional[str]:
+    """First field on which the banked signature disagrees with the
+    live environment, or ``None`` when the entry is replayable here."""
+    if not isinstance(banked, dict):
+        return "signature missing"
+    for field, want in live.items():
+        got = banked.get(field)
+        if got != want:
+            return f"{field}: banked {got!r} != live {want!r}"
+    return None
+
+
+def lookup(key: Tuple, signature: dict, avals: Tuple,
+           dirpath: Optional[str] = None
+           ) -> Optional[Tuple[bytes, bytes, dict]]:
+    """Raw banked bytes for ``key`` — ``(payload, out_tree_bytes,
+    entry_meta)`` — or ``None`` (classified miss). The caller
+    deserializes; this layer only guarantees the entry was banked for
+    THIS key in an environment matching ``signature``/``avals``."""
+    eid = entry_id(key)
+    entry = load_index(dirpath).get(eid)
+    if entry is None:
+        return None
+    why = _signature_mismatch(entry.get("signature"), signature)
+    if why is None and entry.get("avals") != _avals_json(avals):
+        why = "operand avals changed"
+    if why is not None:
+        _cache_error(os.path.join(bank_dir(dirpath) or "", "index.json"),
+                     f"entry {eid}: {why}")
+        return None
+    blob_path = os.path.join(bank_dir(dirpath) or "",
+                             str(entry.get("payload", "")))
+    try:
+        with open(blob_path, "rb") as f:
+            container = pickle.loads(f.read())
+        payload = container["payload"]
+        out_tree = container["out_tree"]
+        if not isinstance(payload, bytes) or not isinstance(out_tree,
+                                                            bytes):
+            raise ValueError("container fields are not bytes")
+    except Exception as e:  # missing/truncated/garbage blob
+        _cache_error(blob_path, f"payload unusable: {e!r}")
+        return None
+    return payload, out_tree, entry
+
+
+def _avals_json(avals: Tuple) -> list:
+    """The aval fingerprint as the JSON shape it round-trips to
+    (tuples become lists), so stored-vs-live comparison is exact."""
+    return json.loads(json.dumps(avals))
+
+
+class _file_lock:
+    """Best-effort cross-process mutex around the read-merge-write
+    cycle — two concurrent writers (e.g. a prewarm pass racing a live
+    solve in another process) would each read, merge only their own
+    entry and atomically replace, silently dropping the other's
+    executable. ``fcntl.flock`` on a ``.lock`` sidecar serializes the
+    cycle; without ``fcntl`` it degrades to a no-op (the write stays
+    atomic and valid, a concurrent entry may be lost — never the
+    file)."""
+
+    def __init__(self, path: str):
+        self._path = path + ".lock"
+        self._fh = None
+
+    def __enter__(self):
+        try:
+            import fcntl
+            self._fh = open(self._path, "a")
+            fcntl.flock(self._fh.fileno(), fcntl.LOCK_EX)
+        except Exception:
+            if self._fh is not None:
+                self._fh.close()
+            self._fh = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._fh is not None:
+            try:
+                import fcntl
+                fcntl.flock(self._fh.fileno(), fcntl.LOCK_UN)
+            except Exception:
+                pass
+            self._fh.close()
+        return False
+
+
+def store_entry(key: Tuple, signature: dict, avals: Tuple,
+                payload: bytes, out_tree: bytes, compile_s: float,
+                dirpath: Optional[str] = None) -> None:
+    """Bank a serialized executable: blob first, index row second
+    (read-merge-atomic-write under the cross-process lock). No-op
+    without a bank dir or on a non-writing rank; a failed write is a
+    trace event, never an exception — the in-process executable is
+    already usable."""
+    dirpath = bank_dir(dirpath)
+    if not dirpath or not rank_writes():
+        return
+    eid = entry_id(key)
+    try:
+        os.makedirs(dirpath, exist_ok=True)
+        blob_name = f"exe_{eid}.bin"
+        blob = pickle.dumps({"payload": payload, "out_tree": out_tree})
+        fd, tmp = tempfile.mkstemp(prefix=f".aot_{os.getpid()}_",
+                                   dir=dirpath)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, os.path.join(dirpath, blob_name))
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+        index_path = os.path.join(dirpath, "index.json")
+        with _file_lock(index_path):
+            entries = load_index(dirpath)
+            entries[eid] = {
+                "key": repr(key),
+                "signature": json.loads(json.dumps(signature)),
+                "avals": _avals_json(avals),
+                "payload": blob_name,
+                "compile_s": round(float(compile_s), 4),
+                "nbytes": len(blob),
+                "created_s": _now(),
+            }
+            doc = {"schema": SCHEMA_VERSION, "entries": entries}
+            fd, tmp = tempfile.mkstemp(
+                prefix=f".aot_index_{os.getpid()}_", dir=dirpath)
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(doc, f, indent=1, sort_keys=True)
+                os.replace(tmp, index_path)
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+    except Exception as e:  # persistence must never break the workload
+        _trace.event("aot.cache_error", cat="aot", path=dirpath,
+                     why=f"write failed: {e!r}")
+
+
+def _now() -> float:
+    import time
+    return round(time.time(), 3)
+
+
+def mem_get(key: Tuple):
+    """Process-local executable for ``key`` (no metrics — the caller
+    classifies the hit tier)."""
+    with _LOCK:
+        return _MEM.get(key)
+
+
+def mem_put(key: Tuple, exe) -> None:
+    with _LOCK:
+        _MEM[key] = exe
+
+
+def clear_memory() -> None:
+    """Drop the process-local executable tier (test isolation
+    helper); also re-arms the one-time corruption warning."""
+    global _warned_corrupt, _warned_mode
+    with _LOCK:
+        _MEM.clear()
+    _warned_corrupt = False
+    _warned_mode = False

@@ -269,7 +269,8 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
                                               None]] = None,
                python: Optional[str] = None,
                inplace: bool = False,
-               quorum: float = 0.5) -> JobResult:
+               quorum: float = 0.5,
+               aot_cache: Optional[str] = None) -> JobResult:
     """Launch ``num_workers`` supervised worker processes and babysit
     them to completion, relaunching on a shrunk world after failures.
 
@@ -314,6 +315,15 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
     with the elastic contract (contract wins — a stale
     ``PYLOPS_MPI_TPU_PROCESS_ID`` from an outer supervised run must not
     leak into workers).
+
+    ``aot_cache`` (a directory) arms the AOT executable bank for every
+    worker (``PYLOPS_MPI_TPU_AOT=on`` + ``PYLOPS_MPI_TPU_AOT_CACHE``,
+    plus the persistent compilation cache under the same root): attempt
+    0 compiles and banks the fused solver programs; every RELAUNCHED
+    attempt prewarms from the bank, so recovery wall-clock stops
+    including a recompile (the cold-start tax the relaunch ladder used
+    to pay per attempt — docs/aot.md#recovery). Explicit ``env``
+    entries for the same knobs win.
 
     In-place recovery (``inplace=True``): each worker additionally gets
     a ``PYLOPS_MPI_TPU_RECONFIG_FILE`` assignment, and when a failure
@@ -365,6 +375,16 @@ def launch_job(argv: Sequence[str], num_workers: int, *,
                 logdir, f"worker{slot}.attempt{attempt}.reconfig.json") \
                 if inplace else ""
             wenv = dict(os.environ)
+            if aot_cache:
+                # relaunch prewarms from the bank attempt 0 seeded —
+                # recovery wall stops paying the recompile (the
+                # compilation cache shares the root as the fallback
+                # layer for programs the bank does not serialize)
+                wenv["PYLOPS_MPI_TPU_AOT"] = "on"
+                wenv["PYLOPS_MPI_TPU_AOT_CACHE"] = aot_cache
+                wenv.setdefault(
+                    "PYLOPS_MPI_TPU_COMPILE_CACHE",
+                    os.path.join(aot_cache, "xla"))
             if env:
                 wenv.update(env)
             wenv.update({

@@ -54,6 +54,18 @@ __all__ = ["k_buckets", "bucket_for", "FamilySpec", "BlockOutcome",
 
 _DEFAULT_BUCKETS = (1, 2, 4, 8, 16)
 
+# (family signature, bucket) pairs whose program went through a
+# compile in THIS process — shared across WarmPool instances so a
+# daemon restart (fresh pool, fresh operator instance, identical
+# program) does not silently recompile at prewarm (the id(Op)-keyed
+# fused cache cannot see the equivalence; the signature can).
+_WARMED_SIGS: set = set()
+
+
+def clear_warmed_signatures() -> None:
+    """Drop the process-wide prewarm ledger (test isolation)."""
+    _WARMED_SIGS.clear()
+
 
 def k_buckets() -> Tuple[int, ...]:
     """``PYLOPS_MPI_TPU_SERVE_K_BUCKETS`` parsed to a sorted tuple of
@@ -110,6 +122,22 @@ class FamilySpec:
     @property
     def nrows(self) -> int:
         return int(self.operator.shape[0])
+
+    def signature(self) -> Tuple:
+        """Structural identity of the family's compiled program:
+        solver configuration plus the operator's AOT fingerprint
+        (class, shape, dtype, leaf avals — ``aot.op_signature``).
+        Two specs with equal signatures lower to the SAME program even
+        when their operator INSTANCES differ (a daemon restart builds
+        a fresh operator), which is what lets prewarm skip recompiles
+        it used to pay silently. Preconditioned families fold in
+        ``id(M)`` — M is closure-captured, so only the same instance
+        reuses a program."""
+        from ..aot import op_signature
+        return (self.solver, int(self.niter), float(self.tol),
+                float(self.damp), str(np.dtype(self.dtype)),
+                op_signature(self.operator),
+                None if self.M is None else ("M", id(self.M)))
 
 
 @dataclass
@@ -225,6 +253,7 @@ class WarmPool:
                     damp=spec.damp, tol=spec.tol, M=spec.M)
         wall = time.perf_counter() - t0
         self.warmed.add((name, bucket))
+        _WARMED_SIGS.add((spec.signature(), bucket))
         _metrics.inc("serve.pool.solves")
         _metrics.observe("serve.batch.fill", k / bucket)
         x = np.asarray(xb.array)[:, :k]
@@ -245,8 +274,24 @@ class WarmPool:
         (no history → assume any fill can arrive). Each compile is a
         zero-RHS solve: the loop condition is false at entry, so the
         cost is exactly one compilation, zero iterations. Returns
-        ``{family: [buckets compiled]}``."""
+        ``{family: [buckets compiled]}``.
+
+        Prewarm is keyed on the family SIGNATURE (shape/dtype/solver
+        config — :meth:`FamilySpec.signature`), not the operator
+        instance id: with the AOT tier armed
+        (``PYLOPS_MPI_TPU_AOT``), a (signature, bucket) pair that
+        already went through a compile in this process is skipped
+        outright — a restarted daemon registering a FRESH operator
+        instance for an identical program stops paying a silent
+        recompile per bucket. (Without the AOT tier the executables
+        live only in the id-keyed fused cache, so an instance change
+        genuinely requires the recompile and the zero-RHS solve runs
+        as before.) With a banked AOT cache on disk, the zero-RHS
+        solves themselves load serialized executables in milliseconds
+        instead of compiling — the cold-start path the bench
+        ``cold_start`` row measures."""
         from ..tuning.plan import cached_batch_widths
+        from ..aot import aot_enabled
         report: Dict[str, list] = {}
         for name in (names if names is not None else self.families()):
             spec = self.family(name)
@@ -258,8 +303,19 @@ class WarmPool:
                         for w in hist if w <= self.k_max]
                 if not want:
                     want = list(self._buckets)
+            sig = spec.signature() if aot_enabled() else None
             done = []
             for b in sorted(set(want)):
+                if sig is not None and (sig, b) in _WARMED_SIGS:
+                    # identical program already compiled (or banked)
+                    # in this process — the signature-keyed AOT tier
+                    # serves it to the new instance without a compile
+                    self.warmed.add((name, b))
+                    done.append(b)
+                    _metrics.inc("serve.pool.prewarm_skipped")
+                    _trace.event("serve.prewarm_skip", cat="serving",
+                                 family=name, bucket=b)
+                    continue
                 with _trace.span("serve.prewarm", cat="serving",
                                  family=name, bucket=b):
                     self.solve(name, np.zeros((spec.nrows, b),

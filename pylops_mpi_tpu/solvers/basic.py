@@ -755,7 +755,8 @@ def clear_fused_cache() -> None:
     _FUSED_CACHE.clear()
 
 
-def _get_fused(Op, key, make_builder, donate_argnums=(), keepalive=None):
+def _get_fused(Op, key, make_builder, donate_argnums=(), keepalive=None,
+               aot_eligible=False):
     """Compile (and cache) the fused loop for ``Op``.
     ``make_builder(op)`` must return the loop with that operator bound;
     the returned fn is called with POSITIONAL runtime operands (the
@@ -776,7 +777,17 @@ def _get_fused(Op, key, make_builder, donate_argnums=(), keepalive=None):
 
     ``keepalive`` pins any extra object whose ``id()`` participates in
     ``key`` (the preconditioner ``M``) for the life of the cache entry,
-    so a freed-then-reallocated object can never alias a stale key."""
+    so a freed-then-reallocated object can never alias a stale key.
+
+    ``aot_eligible=True`` (set only by call sites whose key carries no
+    process-local ids past element 0 — unpreconditioned, no armed
+    fault spec) routes the jit-argument branch through the AOT
+    executable bank (``pylops_mpi_tpu/aot/``) when
+    ``PYLOPS_MPI_TPU_AOT`` arms it: the program is lowered+compiled
+    explicitly, serialized to the bank, and on the next process start
+    loaded in milliseconds instead of recompiled. With the tier off
+    (the default) this parameter contributes NOTHING — same jit, same
+    keys, bit-identical HLO (tests/test_aot.py pins it)."""
     from ..linearoperator import operator_is_jit_arg
     from ..ops._precision import donation_enabled
     donate = tuple(donate_argnums) if donation_enabled() else ()
@@ -794,9 +805,13 @@ def _get_fused(Op, key, make_builder, donate_argnums=(), keepalive=None):
         if operator_is_jit_arg(Op):
             jfn = jax.jit(lambda op, *a: make_builder(op)(*a),
                           donate_argnums=tuple(i + 1 for i in donate))
-
-            def fn(*a, _jfn=jfn, _op=Op):
-                return _jfn(_op, *a)
+            fn = None
+            if aot_eligible:
+                from .. import aot as _aot
+                fn = _aot.maybe_aot_fused(jfn, Op, key)
+            if fn is None:
+                def fn(*a, _jfn=jfn, _op=Op):
+                    return _jfn(_op, *a)
         else:
             fn = jax.jit(make_builder(Op), donate_argnums=donate)
         entry = (fn, Op, keepalive)
@@ -847,7 +862,8 @@ def _run_cg_fused(Op, y: Vector, x0: Vector, x0_owned: bool, niter: int,
                         lambda op: partial(_cg_fused, op, niter=niter,
                                            guards=True, M=M,
                                            stall_n=stall_n, fault=spec),
-                        donate_argnums=_DONATE_X0, keepalive=M)
+                        donate_argnums=_DONATE_X0, keepalive=M,
+                        aot_eligible=(M is None and spec is None))
         x, iiter, cost, status = fn(
             y, x0 if x0_owned else _donate_copy(x0), tol)
         iiter, code = int(iiter), int(status)
@@ -858,7 +874,8 @@ def _run_cg_fused(Op, y: Vector, x0: Vector, x0_owned: bool, niter: int,
     fn = _get_fused(Op, (id(Op), "cg", niter, _vkey(y),
                          _vkey(x0)) + _mkey(M),
                     lambda op: partial(_cg_fused, op, niter=niter, M=M),
-                    donate_argnums=_DONATE_X0, keepalive=M)
+                    donate_argnums=_DONATE_X0, keepalive=M,
+                    aot_eligible=(M is None))
     x, iiter, cost = fn(y, x0 if x0_owned else _donate_copy(x0), tol)
     iiter = int(iiter)
     # host-side, AFTER the fused loop returned: metrics never add an
@@ -957,7 +974,8 @@ def _run_cgls_fused(Op, y: Vector, x0: Vector, x0_owned: bool,
                         lambda op: partial(builder, op, niter=niter,
                                            guards=True, M=M,
                                            stall_n=stall_n, fault=spec),
-                        donate_argnums=_DONATE_X0, keepalive=M)
+                        donate_argnums=_DONATE_X0, keepalive=M,
+                        aot_eligible=(M is None and spec is None))
         x, iiter, cost, cost1, kold, status = fn(
             y, x0 if x0_owned else _donate_copy(x0), damp, tol)
         iiter, code = int(iiter), int(status)
@@ -969,7 +987,8 @@ def _run_cgls_fused(Op, y: Vector, x0: Vector, x0_owned: bool,
     fn = _get_fused(Op, (id(Op), "cgls", use_normal, niter,
                          _vkey(y), _vkey(x0)) + _mkey(M),
                     lambda op: partial(builder, op, niter=niter, M=M),
-                    donate_argnums=_DONATE_X0, keepalive=M)
+                    donate_argnums=_DONATE_X0, keepalive=M,
+                    aot_eligible=(M is None))
     x, iiter, cost, cost1, kold = fn(
         y, x0 if x0_owned else _donate_copy(x0), damp, tol)
     iiter = int(iiter)

@@ -391,7 +391,8 @@ def block_cg(Op, y: DistributedArray,
                      _rstatus.guards_signature(True)) + _mkey(M),
                 lambda op: partial(_block_cg_fused, op, niter=niter,
                                    M=M, guards=True, stall_n=stall_n),
-                donate_argnums=_DONATE_X0, keepalive=M)
+                donate_argnums=_DONATE_X0, keepalive=M,
+                aot_eligible=(M is None))
             x, iiter, cost, status = fn(
                 y, x0 if x0_owned else _donate_copy(x0), tol)
             iiter = int(iiter)
@@ -405,7 +406,8 @@ def block_cg(Op, y: DistributedArray,
                              _vkey(x0)) + _mkey(M),
                         lambda op: partial(_block_cg_fused, op,
                                            niter=niter, M=M),
-                        donate_argnums=_DONATE_X0, keepalive=M)
+                        donate_argnums=_DONATE_X0, keepalive=M,
+                        aot_eligible=(M is None))
         x, iiter, cost = fn(y, x0 if x0_owned else _donate_copy(x0),
                             tol)
         iiter = int(iiter)
@@ -462,7 +464,8 @@ def block_cgls(Op, y: DistributedArray,
                      _rstatus.guards_signature(True)) + _mkey(M),
                 lambda op: partial(_block_cgls_fused, op, niter=niter,
                                    M=M, guards=True, stall_n=stall_n),
-                donate_argnums=_DONATE_X0, keepalive=M)
+                donate_argnums=_DONATE_X0, keepalive=M,
+                aot_eligible=(M is None))
             x, iiter, cost, cost1, kold, status = fn(
                 y, x0 if x0_owned else _donate_copy(x0), damp, tol)
             iiter = int(iiter)
@@ -476,7 +479,8 @@ def block_cgls(Op, y: DistributedArray,
                                  _vkey(x0)) + _mkey(M),
                             lambda op: partial(_block_cgls_fused, op,
                                                niter=niter, M=M),
-                            donate_argnums=_DONATE_X0, keepalive=M)
+                            donate_argnums=_DONATE_X0, keepalive=M,
+                            aot_eligible=(M is None))
             x, iiter, cost, cost1, kold = fn(
                 y, x0 if x0_owned else _donate_copy(x0), damp, tol)
             iiter = int(iiter)
@@ -587,7 +591,7 @@ def block_cg_segmented(Op, y: DistributedArray,
                      _vkey(x0)) + _mkey(M),
                 lambda op: _block_cg_setup_builder(op, niter=niter,
                                                    M=M),
-                keepalive=M)
+                keepalive=M, aot_eligible=(M is None))
             x, r, c, kold, cost, floors = setup(y, x0)
             state = dict(zip(fields, [
                 x, r, c, kold, jnp.asarray(0), cost, _status0(K),
@@ -599,7 +603,7 @@ def block_cg_segmented(Op, y: DistributedArray,
                   stall_n if guards_on else None)) + _mkey(M),
             lambda op: _block_cg_epoch_builder(op, guards=guards_on,
                                                stall_n=stall_n, M=M),
-            keepalive=M)
+            keepalive=M, aot_eligible=(M is None))
         epochs = 0
         while True:
             iiter = int(state["iiter"])

@@ -731,7 +731,8 @@ def _call_pipe_cg(Op, y, x0, x0_owned, niter, tol, guards, M, *,
                                        guards=guards, M=M,
                                        stall_n=stall_n, fault=spec,
                                        block=block),
-                    donate_argnums=_DONATE_X0, keepalive=M)
+                    donate_argnums=_DONATE_X0, keepalive=M,
+                    aot_eligible=(M is None and spec is None))
     out = fn(y, x0 if x0_owned else _donate_copy(x0), tol)
     if guards:
         x, iiter, cost, status = out
@@ -762,7 +763,8 @@ def run_cg_fused(Op, y, x0, x0_owned, niter, tol, guards, M=None,
                         lambda op: partial(_sstep_cg_fused, op,
                                            niter=niter, s=s, guards=guards,
                                            M=M, stall_n=stall_n),
-                        donate_argnums=_DONATE_X0, keepalive=M)
+                        donate_argnums=_DONATE_X0, keepalive=M,
+                        aot_eligible=(M is None))
         x, iiter, cost, status = fn(
             y, x0 if x0_owned else _donate_copy(x0), tol)
         iiter, code = int(iiter), int(status)
@@ -812,7 +814,8 @@ def run_cgls_fused(Op, y, x0, x0_owned, niter, damp, tol, use_normal,
                     lambda op: partial(_pipe_cgls_fused, op, niter=niter,
                                        normal=use_normal, guards=guards,
                                        M=M, stall_n=stall_n, fault=spec),
-                    donate_argnums=_DONATE_X0, keepalive=M)
+                    donate_argnums=_DONATE_X0, keepalive=M,
+                    aot_eligible=(M is None and spec is None))
     out = fn(y, x0 if x0_owned else _donate_copy(x0), damp, tol)
     if guards:
         x, iiter, cost, cost1, kold, status = out
@@ -862,7 +865,8 @@ def run_block_cgls(Op, y, x0, x0_owned, niter, damp, tol, guards,
                                        normal=False, guards=guards, M=M,
                                        stall_n=stall_n, fault=spec,
                                        block=True),
-                    donate_argnums=_DONATE_X0, keepalive=M)
+                    donate_argnums=_DONATE_X0, keepalive=M,
+                    aot_eligible=(M is None and spec is None))
     out = fn(y, x0 if x0_owned else _donate_copy(x0), damp, tol)
     if guards:
         x, iiter, cost, cost1, kold, status = out

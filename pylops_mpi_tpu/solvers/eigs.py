@@ -116,7 +116,7 @@ def _power_iteration_fused(Op, b_k: Vector, niter: int, tol):
         # the normalized-iterate carry starts in its buffer
         fn = _get_fused(Op, (id(Op), "power", _vkey(b_k)),
                         lambda op: partial(_power_run, op),
-                        donate_argnums=(0,))
+                        donate_argnums=(0,), aot_eligible=True)
         b_k, maxeig, iiter = fn(b_k, niter, tol)
     else:
         b_k, maxeig, iiter = _power_run(Op, b_k, niter, tol)

@@ -340,7 +340,8 @@ def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
                                + _ca.ca_key(ca, ca_s) + _mkey(M),
                                lambda op: setup_builder(op, niter=niter,
                                                         M=M),
-                               keepalive=M)
+                               keepalive=M,
+                               aot_eligible=(M is None))
             out = setup(y, x0, damp, damp2) if is_cgls else setup(y, x0)
             if ca == "sstep":
                 nh = len(fields) - 6
@@ -389,7 +390,7 @@ def _segmented(Op, y, x0, solver, niter, damp, tol, epoch,
                          lambda op: run_builder(op, niter=niter,
                                                 guards=guards_on,
                                                 stall_n=stall_n, M=M),
-                         keepalive=M)
+                         keepalive=M, aot_eligible=(M is None))
 
         epochs = 0
         while True:

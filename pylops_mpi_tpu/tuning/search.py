@@ -124,12 +124,19 @@ def measure_candidates(space: _space.TuningSpace, ctx: Dict,
         if rec.get("ok") and isinstance(rec.result, dict):
             trial["best_s"] = rec.result.get("best_s")
             trial["mean_s"] = rec.result.get("mean_s")
+            # compile-vs-run split (AOT tier): how much of the trial's
+            # budget went to the warmup compile rather than the timed
+            # measurements. With a banked executable or a warm
+            # persistent compilation cache this collapses toward
+            # best_s — budget buys measurements, not compiles.
+            trial["compile_s"] = rec.result.get("compile_s")
             measured.append((float(rec.result["best_s"]), params))
         trials.append(trial)
         # the replay-proof event: a warm cache produces ZERO of these
         _trace.event("tuning.trial", cat="tuning", op=space.op,
                      params=params, skipped=trial["skipped"],
-                     ok=trial["ok"], best_s=trial.get("best_s"))
+                     ok=trial["ok"], best_s=trial.get("best_s"),
+                     compile_s=trial.get("compile_s"))
     if not measured:
         return None, trials
     best_t, best_p = min(measured, key=lambda t: t[0])

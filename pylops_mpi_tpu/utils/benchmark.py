@@ -157,11 +157,20 @@ def time_callable(fn: Callable, repeats: int = 3, warmup: int = 1):
     (``_sync`` on the returned value — the same barrier the
     ``@benchmark`` decorator applies): ``warmup`` unrecorded calls
     (compile/first-dispatch), then ``repeats`` timed calls. Returns
-    ``{"best_s", "mean_s", "times_s"}`` — the timing primitive behind
-    the autotuner's measurement trials
-    (:mod:`pylops_mpi_tpu.tuning.search`)."""
-    for _ in range(max(0, int(warmup))):
+    ``{"best_s", "mean_s", "times_s", "compile_s"}`` — the timing
+    primitive behind the autotuner's measurement trials
+    (:mod:`pylops_mpi_tpu.tuning.search`). ``compile_s`` is the wall
+    of the FIRST warmup call (compile + first dispatch; ``None`` with
+    ``warmup=0``) — the split that lets the tuner report measurement
+    budget spent compiling vs measuring, and that collapses toward
+    the run floor when the AOT bank or the persistent compilation
+    cache already holds the program."""
+    compile_s = None
+    for i in range(max(0, int(warmup))):
+        t0 = time.perf_counter()
         _sync((fn(),))
+        if i == 0:
+            compile_s = time.perf_counter() - t0
     times = []
     for _ in range(max(1, int(repeats))):
         _sync()
@@ -171,7 +180,8 @@ def time_callable(fn: Callable, repeats: int = 3, warmup: int = 1):
         times.append(time.perf_counter() - t0)
     return {"best_s": min(times),
             "mean_s": sum(times) / len(times),
-            "times_s": times}
+            "times_s": times,
+            "compile_s": compile_s}
 
 
 @contextmanager

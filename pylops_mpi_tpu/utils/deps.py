@@ -332,6 +332,25 @@ KNOBS = [
      "onto every solver reduction result so the CPU sim becomes "
      "latency-dominated like a pod fabric; unset/0 traces "
      "bit-identical programs"),
+    ("PYLOPS_MPI_TPU_AOT", "auto|on|off", "off",
+     "aot/store.py (solvers/basic.py, serving/engine.py)",
+     "ahead-of-time executable tier for the fused solver programs: "
+     "on lowers+compiles explicitly, serializes the executable "
+     "(PJRT) into the bank, and replays it through the flat-call "
+     "path on the next process start; auto arms only when AOT_CACHE "
+     "is set; off (default) traces today's jit path bit-identically"),
+    ("PYLOPS_MPI_TPU_AOT_CACHE", "directory", "unset (memory-only)",
+     "aot/store.py",
+     "on-disk bank for serialized executables (index.json + one blob "
+     "per entry, schema-versioned, atomic, flock'd read-merge-write; "
+     "rank 0 writes, other ranks read); unset under AOT=on keeps the "
+     "bank process-local in memory"),
+    ("PYLOPS_MPI_TPU_COMPILE_CACHE", "directory", "unset (off)",
+     "aot/compile_cache.py (package import)",
+     "JAX persistent compilation cache dir — the fallback compile "
+     "tier for programs the AOT bank does not serialize (closure "
+     "operators, preconditioned solves, ISTA/FISTA); shared per CI "
+     "job, rank-0-writes/others-read on multi-host"),
 ]
 
 
