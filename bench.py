@@ -957,6 +957,101 @@ def _ca_race_row():
             pass
 
 
+def _grad_race_row():
+    """Gradient race (autodiff-PR acceptance bar): d loss/d y through a
+    fused CGLS solve, the implicit fixed-point rule (backward = ONE
+    more fused solve, ``pylops_mpi_tpu/autodiff/implicit.py``) vs the
+    unrolled scan-tape oracle (what reverse-mode gives everyone else —
+    O(niter·n) residency). Both arms compile ``jit(grad(loss))`` once,
+    then time 3 post-compile reps; the compiler's own
+    ``memory_analysis().temp_size_in_bytes`` stamps each program's
+    scratch residency (None when the backend does not report it).
+    Agreement between the two gradients is stamped as
+    ``max_rel_diff`` — the wall/memory win only counts on matching
+    numbers. Error-isolated like every race row."""
+    try:
+        import numpy as _np
+        import jax as _jax
+        import jax.numpy as _jnp
+        from pylops_mpi_tpu import DistributedArray, MPIBlockDiag
+        from pylops_mpi_tpu.ops.local import MatrixMult
+        from pylops_mpi_tpu.autodiff import cgls_solve, unrolled_cgls
+        from pylops_mpi_tpu.solvers import clear_fused_cache
+
+        rng = _np.random.default_rng(23)
+        nblk = max(len(_jax.devices()), 2)
+        bm, bn, niter = 48, 32, 60
+        mats = [rng.standard_normal((bm, bn)) for _ in range(nblk)]
+        Op = MPIBlockDiag([MatrixMult(m, dtype=_np.float64)
+                           for m in mats])
+        y = DistributedArray.to_dist(
+            rng.standard_normal(nblk * bm))
+        x0 = DistributedArray.to_dist(_np.zeros(nblk * bn))
+        w = _jnp.asarray(rng.standard_normal(nblk * bn))
+        damp = 1e-3
+
+        def loss_implicit(y_):
+            x = cgls_solve(Op, y_, x0, niter=niter, damp=damp,
+                           tol=0.0)
+            return _jnp.vdot(w, x._arr.ravel()).real
+
+        def loss_unrolled(y_):
+            x = unrolled_cgls(Op, y_, x0, niter=niter, damp=damp)
+            return _jnp.vdot(w, x._arr.ravel()).real
+
+        clear_fused_cache()
+        out, grads = {}, {}
+        for name, fn in (("implicit", loss_implicit),
+                         ("unrolled", loss_unrolled)):
+            compiled = _jax.jit(_jax.grad(fn)).lower(y).compile()
+            g = compiled(y)
+            _jax.block_until_ready(g._arr)    # compile/warm outside
+            reps = 3
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                g = compiled(y)
+                _jax.block_until_ready(g._arr)
+            t = (time.perf_counter() - t0) / reps
+            temp = None
+            try:  # CPU backends may not report a memory analysis
+                ma = compiled.memory_analysis()
+                v = getattr(ma, "temp_size_in_bytes", None)
+                temp = int(v) if v is not None else None
+            except Exception:
+                temp = None
+            grads[name] = _np.asarray(g.asarray())
+            out[name] = {"wall_s": _sig3(t),
+                         "grads_per_sec": _sig3(1.0 / t),
+                         "temp_bytes": temp}
+        scale = max(1.0, float(_np.max(_np.abs(grads["unrolled"]))))
+        diff = float(_np.max(_np.abs(grads["implicit"]
+                                     - grads["unrolled"]))) / scale
+        ti = 1.0 / out["implicit"]["grads_per_sec"]
+        tu = 1.0 / out["unrolled"]["grads_per_sec"]
+        mi = out["implicit"]["temp_bytes"]
+        mu = out["unrolled"]["temp_bytes"]
+        return {
+            "problem": {"nblk": nblk, "bm": bm, "bn": bn,
+                        "niter": niter, "dtype": "float64"},
+            **out,
+            # the sentinel sub-verdict rides this top-level rate
+            "grads_per_sec": out["implicit"]["grads_per_sec"],
+            "wall_speedup": _sig3(tu / ti) if ti else None,
+            "temp_bytes_ratio": (_sig3(mu / mi)
+                                 if mi and mu else None),
+            "max_rel_diff": _sig3(diff),
+            "grads_match": diff <= 1e-5,
+        }
+    except Exception as e:  # the race must never cost the headline
+        return {"error": repr(e)[:300]}
+    finally:
+        try:
+            from pylops_mpi_tpu.solvers import clear_fused_cache
+            clear_fused_cache()
+        except Exception:
+            pass
+
+
 # dense matmul peak per chip, TFLOP/s (bf16 inputs, f32 accumulation on
 # the MXU) — public spec-sheet numbers; most-specific key checked first
 _PEAK_TFLOPS = [
@@ -1639,6 +1734,15 @@ def child_main():
         _progress("CA race (classic vs pipelined CG, stalled reduce)")
         ca_race = _ca_race_row()
 
+    # gradient race (autodiff PR): implicit fixed-point gradient vs
+    # the unrolled scan-tape oracle through a fused CGLS solve; every
+    # CPU-sim round, BENCH_GRAD_PYLOPS_MPI_TPU=1 forces it on hardware
+    grad_race = None
+    grad_env = os.environ.get("BENCH_GRAD_PYLOPS_MPI_TPU", "")
+    if grad_env != "0" and (not on_tpu or grad_env == "1"):
+        _progress("gradient race (implicit vs unrolled d/dy of CGLS)")
+        grad_race = _grad_race_row()
+
     # cold-start race (AOT PR): daemon prewarm wall with a cold
     # executable bank vs the same bank warm, bit-identity vs AOT=off;
     # every CPU-sim round, BENCH_COLD_START_PYLOPS_MPI_TPU=1 forces
@@ -1815,6 +1919,7 @@ def child_main():
         **({"precond": precond_race} if precond_race else {}),
         **({"sparse_vs_dense": sparse_race} if sparse_race else {}),
         **({"ca_vs_classic": ca_race} if ca_race else {}),
+        **({"grad_race": grad_race} if grad_race else {}),
         **({"cold_start": cold_start} if cold_start else {}),
         **({"selfcheck": selfcheck} if selfcheck is not None else {}),
         **({"cpu_breakdown": cpu_breakdown} if cpu_breakdown else {}),
@@ -2031,7 +2136,8 @@ def _merge_tpu_cache(result, root=None):
                              "spill", "tune_race", "batched", "serving",
                              "hierarchical_vs_flat", "spill_oversized",
                              "precond", "sparse_vs_dense",
-                             "ca_vs_classic", "cold_start", "aot")
+                             "ca_vs_classic", "grad_race",
+                             "cold_start", "aot")
                             if k in result}
                 result = dict(r)
                 result["cached"] = True
@@ -2077,6 +2183,11 @@ def _merge_tpu_cache(result, root=None):
                 if cpu_live.get("ca_vs_classic") is not None:
                     result["ca_vs_classic"] = \
                         cpu_live["ca_vs_classic"]
+                # and the gradient race: live CPU-sim implicit-vs-
+                # unrolled wall/memory evidence that rides every
+                # compact line (autodiff PR)
+                if cpu_live.get("grad_race") is not None:
+                    result["grad_race"] = cpu_live["grad_race"]
                 # and the cold-start race: live CPU-sim prewarm walls
                 # (cold vs banked AOT executable bank) that ride every
                 # compact line (round 18)
@@ -2419,6 +2530,27 @@ def _sentinel_check(result, history, tolerance=0.15):
         if ca_reg:
             verdict.update(status="regressed", regressed=True)
 
+    # gradient sub-verdict (autodiff PR): the implicit rule's
+    # grads/sec rides the same bucketed-median rule — the one-extra-
+    # solve backward pass must stay a throughput win over history,
+    # not just beat the unrolled tape once. Same stand-down rule:
+    # rounds banked before the row existed carry no number.
+    def _grad_rate(row):
+        g = row.get("grad_race") or {}
+        v = g.get("grads_per_sec")
+        return float(v) if isinstance(v, (int, float)) and v > 0 else None
+    fresh_gr = _grad_rate(result)
+    hist_gr = [v for v in (_grad_rate(h) for h in rows) if v is not None]
+    if fresh_gr is not None and hist_gr:
+        base = statistics.median(hist_gr)
+        gr_reg = fresh_gr < base * (1.0 - tolerance)
+        verdict["grad"] = {"fresh": round(fresh_gr, 4),
+                           "baseline": round(base, 4),
+                           "ratio": round(fresh_gr / base, 4),
+                           "regressed": gr_reg}
+        if gr_reg:
+            verdict.update(status="regressed", regressed=True)
+
     # cold-start sub-verdict (AOT PR): banked prewarm SECONDS ride the
     # bucketed-median rule INVERTED — lower is better, so this trips
     # when a fresh banked prewarm runs SLOWER than median × (1 + tol).
@@ -2621,6 +2753,17 @@ def _compact_line(result):
         ) if v is not None}
     elif car.get("error"):
         compact["ca"] = {"error": car["error"][:120]}
+    gr = result.get("grad_race") or {}
+    if gr and not gr.get("error"):
+        compact["grad"] = {k: v for k, v in (
+            ("wall_speedup", gr.get("wall_speedup")),
+            ("temp_bytes_ratio", gr.get("temp_bytes_ratio")),
+            ("max_rel_diff", gr.get("max_rel_diff")),
+            ("grads_match", gr.get("grads_match")),
+            ("grads_per_sec", gr.get("grads_per_sec")),
+        ) if v is not None}
+    elif gr.get("error"):
+        compact["grad"] = {"error": gr["error"][:120]}
     cs = result.get("cold_start") or {}
     if cs and not cs.get("error"):
         compact["cold_start"] = {

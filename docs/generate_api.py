@@ -247,6 +247,20 @@ PAGES = {
          "pylops_mpi_tpu.aot",
          ["maybe_enable_compile_cache", "compile_cache_dir"]),
     ],
+    "autodiff": [
+        ("Operator rules (adjoint VJP/JVP)", "pylops_mpi_tpu.autodiff",
+         ["make_differentiable", "DifferentiableOperator"]),
+        ("Rule internals", "pylops_mpi_tpu.autodiff.rules",
+         ["transpose_apply", "param_cotangent", "zero_op_cotangent"]),
+        ("Implicit differentiation through the fused solvers",
+         "pylops_mpi_tpu.autodiff",
+         ["cg_solve", "cgls_solve", "block_cg_solve",
+          "block_cgls_solve"]),
+        ("Unrolled (scan-tape) oracles", "pylops_mpi_tpu.autodiff",
+         ["unrolled_cg", "unrolled_cgls"]),
+        ("Training driver", "pylops_mpi_tpu.autodiff",
+         ["fit", "trainable_leaves", "param_count"]),
+    ],
     "models": [
         ("Model workflows", "pylops_mpi_tpu.models",
          ["PoststackLinearModelling", "MPIPoststackLinearModelling",
@@ -269,6 +283,7 @@ PAGE_TITLES = {
     "tuning": "Autotuning",
     "serving": "Serving (always-on solve service)",
     "aot": "Ahead-of-time compile tier",
+    "autodiff": "Differentiable operator layer",
     "models": "Model workflows",
 }
 

@@ -29,6 +29,17 @@ __all__ = ["MPILinearOperator", "LinearOperator", "aslinearoperator",
 VectorLike = Union[DistributedArray, StackedDistributedArray]
 
 
+def _scalar_like(x) -> bool:
+    """Python/numpy scalars plus 0-d arrays (jax or numpy) — the
+    latter possibly TRACED, which is how a learnable scalar weight
+    (``eps * Reg`` under ``jax.grad``) enters the operator algebra."""
+    if np.isscalar(x):
+        return True
+    import jax
+    return (isinstance(x, (jax.Array, np.ndarray, np.generic))
+            and np.ndim(x) == 0)
+
+
 class MPILinearOperator:
     """Abstract distributed linear operator
     (ref ``pylops_mpi/LinearOperator.py:16-168``).
@@ -168,10 +179,13 @@ class MPILinearOperator:
     # ----------------------------------------------------------- algebra
     def dot(self, x):
         """Operator-operator, operator-scalar or operator-vector product
-        (ref ``LinearOperator.py:244-280``)."""
+        (ref ``LinearOperator.py:244-280``). Scalars include 0-d
+        jax/numpy arrays — possibly TRACED (a learnable ``eps * Reg``
+        weight under ``jax.grad``): the scale rides in ``args`` as a
+        differentiable pytree leaf."""
         if isinstance(x, MPILinearOperator):
             return _ProductLinearOperator(self, x)
-        if np.isscalar(x):
+        if _scalar_like(x):
             return _ScaledLinearOperator(self, x)
         if isinstance(x, StackedDistributedArray) or x.ndim == 1:
             return self.matvec(x)
@@ -202,17 +216,17 @@ class MPILinearOperator:
         return self.dot(x)
 
     def __rmul__(self, x):
-        if np.isscalar(x):
+        if _scalar_like(x):
             return _ScaledLinearOperator(self, x)
         return NotImplemented
 
     def __matmul__(self, x):
-        if np.isscalar(x):
+        if _scalar_like(x):
             raise ValueError("Scalar not allowed, use * instead")
         return self.__mul__(x)
 
     def __rmatmul__(self, x):
-        if np.isscalar(x):
+        if _scalar_like(x):
             raise ValueError("Scalar not allowed, use * instead")
         return self.__rmul__(x)
 
@@ -235,6 +249,18 @@ class MPILinearOperator:
         FLOPs-for-HBM trade for long composed chains whose activation
         memory would not fit. No effect outside AD."""
         return _CheckpointedLinearOperator(self)
+
+    def todifferentiable(self, mode: str = "vjp", params=None) \
+            -> "MPILinearOperator":
+        """Wrap the operator with the adjoint autodiff rules: under
+        ``jax.grad``/``jax.vjp`` (``mode="vjp"``) or ``jax.jvp``
+        (``mode="jvp"``) its applies differentiate by the hand-written
+        ``rmatvec``/``matvec`` instead of a machine-derived transpose
+        of the forward collective schedule. See
+        :class:`pylops_mpi_tpu.autodiff.DifferentiableOperator` for the
+        ``params`` (operator-leaf cotangents) contract."""
+        from .autodiff.rules import make_differentiable
+        return make_differentiable(self, mode=mode, params=params)
 
     def todense(self) -> np.ndarray:
         """Dense matrix of the operator, by applying it to each identity
@@ -352,11 +378,16 @@ class _ScaledLinearOperator(MPILinearOperator):
     accepts_block = True
 
     def __init__(self, A: MPILinearOperator, alpha):
-        if not np.isscalar(alpha):
+        if not _scalar_like(alpha):
             raise ValueError("scalar expected as alpha")
         self.args = (A, alpha)
         self.dims, self.dimsd = A.dims, A.dimsd
-        super().__init__(shape=A.shape, dtype=_get_dtype([A], [type(alpha)]))
+        # 0-d arrays (possibly traced) carry their own dtype; python
+        # scalars keep the type-promotion rule of the reference
+        adt = getattr(alpha, "dtype", None)
+        super().__init__(shape=A.shape,
+                         dtype=_get_dtype([A], [adt if adt is not None
+                                                else type(alpha)]))
 
     @staticmethod
     def _conj(alpha):

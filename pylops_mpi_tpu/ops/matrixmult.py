@@ -672,7 +672,14 @@ def MPIMatrixMult(A, M: int, saveAt: bool = False, mesh=None,
 
 
 # sharded matrix tiles travel into jit as pytree children
-# (multi-process arrays must not be closed over — linearoperator.py)
+# (multi-process arrays must not be closed over — linearoperator.py).
+# The same registration makes the tiles DIFFERENTIABLE leaves for the
+# autodiff tier (adjoint rules / implicit solver VJPs): gradients flow
+# to ``A`` — and, when ``saveAt=True`` stored a separate ``At``, to
+# ``At`` INDEPENDENTLY, because the rules cannot know the two tiles
+# alias one matrix. A training loop updating weights must either keep
+# ``saveAt=False`` (``At`` is None → a single source of truth) or fold
+# ``gA + gAt.conj().T``-style cotangent pairs itself (docs/autodiff.md).
 from ..linearoperator import register_operator_arrays  # noqa: E402
 for _c in (_MPIBlockMatrixMult, _MPISummaMatrixMult, _MPIAutoMatrixMult):
     register_operator_arrays(_c, "A", "At")

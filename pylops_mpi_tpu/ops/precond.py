@@ -495,3 +495,16 @@ def make_precond(Op, kind: Optional[str] = None, **kw):
     raise ValueError(
         f"unknown preconditioner kind {kind!r}; expected none, jacobi, "
         "block_jacobi or mg")
+
+
+# Pytree registration (autodiff tier): the factored preconditioner
+# state rides as differentiable leaves, so a JacobiPrecond used INSIDE
+# a composed operator (not as the gradient-transparent ``M=`` seam,
+# which never needs this) yields diagonal/Cholesky cotangents through
+# the adjoint rules like any other operator parameter. The ``M=`` seam
+# path is unchanged — builders close over ``M`` and key on ``id(M)``
+# whether or not the class is registered.
+from ..linearoperator import register_operator_arrays  # noqa: E402
+
+register_operator_arrays(JacobiPrecond, "_dinv")
+register_operator_arrays(BlockJacobiPrecond, "_chol")

@@ -255,6 +255,10 @@ class MPISparseMatrixMult(MPILinearOperator):
         return full[:self.Ncol]
 
 
+# Autodiff tier: ``_data`` (COO values) is the differentiable leaf —
+# adjoint rules and implicit solver VJPs deliver value cotangents there.
+# ``_rows``/``_cols`` are integer structure: their cotangents are float0
+# (symbolic zeros), i.e. the sparsity PATTERN is not trainable.
 register_operator_arrays(MPISparseMatrixMult, "_data", "_rows", "_cols")
 
 

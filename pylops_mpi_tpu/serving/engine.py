@@ -113,6 +113,12 @@ class FamilySpec:
     # PCGLS program, and M=None families lower bit-identically to the
     # pre-preconditioner engine
     M: object = None
+    # opt-in marker for families served with PYLOPS_MPI_TPU_AUTODIFF=on
+    # whose callers differentiate through the solve (autodiff/implicit).
+    # Folded into signature() ONLY when True so the default False keeps
+    # every existing family signature — and therefore every prewarm/AOT
+    # bank key — byte-identical to the pre-autodiff engine.
+    differentiable: bool = False
 
     def __post_init__(self):
         if self.solver not in ("cg", "cgls"):
@@ -132,12 +138,16 @@ class FamilySpec:
         a fresh operator), which is what lets prewarm skip recompiles
         it used to pay silently. Preconditioned families fold in
         ``id(M)`` — M is closure-captured, so only the same instance
-        reuses a program."""
+        reuses a program. ``differentiable`` is folded in only when
+        True (key neutrality for the default)."""
         from ..aot import op_signature
-        return (self.solver, int(self.niter), float(self.tol),
-                float(self.damp), str(np.dtype(self.dtype)),
-                op_signature(self.operator),
-                None if self.M is None else ("M", id(self.M)))
+        sig = (self.solver, int(self.niter), float(self.tol),
+               float(self.damp), str(np.dtype(self.dtype)),
+               op_signature(self.operator),
+               None if self.M is None else ("M", id(self.M)))
+        if self.differentiable:
+            sig = sig + ("differentiable",)
+        return sig
 
 
 @dataclass

@@ -899,7 +899,24 @@ def cg(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
 
     ``M`` is an optional preconditioner (an ``MPILinearOperator``
     approximating ``Op⁻¹``, SPD) applied to the residual inside the
-    fused while_loop — see docs/preconditioning.md. Fused path only."""
+    fused while_loop — see docs/preconditioning.md. Fused path only.
+
+    Under ``PYLOPS_MPI_TPU_AUTODIFF=on``, traced inputs (calls inside
+    ``jax.jit``/``jax.grad``) reroute to the implicit-diff rule
+    (autodiff/implicit.py) instead of failing on host conversions —
+    fused path only, guards excluded; with the knob off (default) this
+    check is one host-side env read and the traced/lowered programs
+    are bit-identical (tests/test_autodiff.py pins it)."""
+    from ..utils import deps as _deps
+    if _deps.autodiff_enabled():
+        from ..autodiff import implicit as _autodiff
+        if _autodiff.should_intercept(Op, y, x0):
+            if callback is not None or show or fused is False:
+                raise ValueError(
+                    "traced cg() (PYLOPS_MPI_TPU_AUTODIFF=on) supports "
+                    "only the fused path: callback/show/fused=False "
+                    "need host synchronization inside the trace")
+            return _autodiff.entry_cg(Op, y, x0, niter, tol, M)
     x0_owned = x0 is None  # freshly built → donate without a copy
     if x0 is None:
         x0 = _zero_like_model(Op, y)
@@ -1016,7 +1033,24 @@ def cgls(Op, y: Vector, x0: Optional[Vector] = None, niter: int = 10,
     ``M`` is an optional preconditioner for the NORMAL system — an SPD
     ``MPILinearOperator`` approximating ``(OpᴴOp + damp²I)⁻¹``, applied
     to the normal residual ``Opᴴ s − damp² x`` inside the fused loop
-    (docs/preconditioning.md). Fused path only."""
+    (docs/preconditioning.md). Fused path only.
+
+    ``PYLOPS_MPI_TPU_AUTODIFF=on`` reroutes traced inputs to the
+    implicit-diff rule — see :func:`cg` (same fused-only restriction;
+    ``normal=True`` is a forward-schedule choice the fixed-point rule
+    does not need, so the traced path always runs the classic
+    two-sweep schedule)."""
+    from ..utils import deps as _deps
+    if _deps.autodiff_enabled():
+        from ..autodiff import implicit as _autodiff
+        if _autodiff.should_intercept(Op, y, x0):
+            if callback is not None or show or fused is False:
+                raise ValueError(
+                    "traced cgls() (PYLOPS_MPI_TPU_AUTODIFF=on) "
+                    "supports only the fused path: callback/show/"
+                    "fused=False need host synchronization inside the "
+                    "trace")
+            return _autodiff.entry_cgls(Op, y, x0, niter, damp, tol, M)
     x0_owned = x0 is None  # freshly built → donate without a copy
     if x0 is None:
         x0 = _zero_like_model(Op, y)

@@ -353,8 +353,18 @@ def block_cg(Op, y: DistributedArray,
     with guards on, per-column status words land in
     ``resilience.status.last_status("block_cg")["columns"]``.
     ``K=1`` routes through the single-RHS fused program — same cache
-    entry, bit-identical HLO."""
+    entry, bit-identical HLO.
+
+    ``PYLOPS_MPI_TPU_AUTODIFF=on`` reroutes traced inputs to the
+    implicit-diff rule (one block backward solve covers all K
+    cotangent columns) — see :func:`~pylops_mpi_tpu.solvers.basic.cg`;
+    guards are excluded on the traced path."""
     _check_block(Op, y)
+    from ..utils import deps as _deps
+    if _deps.autodiff_enabled():
+        from ..autodiff import implicit as _autodiff
+        if _autodiff.should_intercept(Op, y, x0):
+            return _autodiff.entry_block_cg(Op, y, x0, niter, tol, M)
     K = int(y.global_shape[1])
     x0_owned = x0 is None
     if x0 is None:
@@ -416,6 +426,31 @@ def block_cg(Op, y: DistributedArray,
         return x, iiter, np.asarray(cost)[:iiter + 1]
 
 
+def _run_block_cgls_fused(Op, y, x0, niter, damp, tol, M=None,
+                          x0_owned: bool = False):
+    """Compile-cache-and-run the unguarded fused block-CGLS loop;
+    raw ``(x, iiter, cost, cost1, kold)`` with ``(iiter+1, K)`` sliced
+    histories — the :func:`~pylops_mpi_tpu.solvers.basic._run_cgls_fused`
+    contract minus the status word. Factored out of :func:`block_cgls`
+    (identical ``_get_fused`` key) so the autodiff tier's concrete
+    forward (autodiff/implicit.py) reuses the SAME cached executables
+    and AOT bank entries as plain solves instead of growing a parallel
+    executable set."""
+    fn = _get_fused(Op, (id(Op), "block_cgls", niter, _vkey(y),
+                         _vkey(x0)) + _mkey(M),
+                    lambda op: partial(_block_cgls_fused, op,
+                                       niter=niter, M=M),
+                    donate_argnums=_DONATE_X0, keepalive=M,
+                    aot_eligible=(M is None))
+    x, iiter, cost, cost1, kold = fn(
+        y, x0 if x0_owned else _donate_copy(x0), damp, tol)
+    iiter = int(iiter)
+    _metrics.inc("solver.block_cgls.solves")
+    _metrics.inc("solver.block_cgls.iterations", iiter)
+    return (x, iiter, np.asarray(cost)[:iiter + 1],
+            np.asarray(cost1)[:iiter + 1], np.asarray(kold))
+
+
 def block_cgls(Op, y: DistributedArray,
                x0: Optional[DistributedArray] = None, niter: int = 10,
                damp: float = 0.0, tol: float = 1e-4,
@@ -424,8 +459,17 @@ def block_cgls(Op, y: DistributedArray,
     :func:`block_cg`. Returns ``(x, istop, iiter, kold, r2norm,
     cost)`` — the :func:`~pylops_mpi_tpu.solvers.basic.cgls` shape with
     per-column ``istop``/``kold``/``r2norm`` vectors and a
-    ``(iiter+1, K)`` cost history."""
+    ``(iiter+1, K)`` cost history.
+
+    ``PYLOPS_MPI_TPU_AUTODIFF=on`` reroutes traced inputs to the
+    implicit-diff rule — see :func:`block_cg`."""
     _check_block(Op, y)
+    from ..utils import deps as _deps
+    if _deps.autodiff_enabled():
+        from ..autodiff import implicit as _autodiff
+        if _autodiff.should_intercept(Op, y, x0):
+            return _autodiff.entry_block_cgls(Op, y, x0, niter, damp,
+                                              tol, M)
     K = int(y.global_shape[1])
     x0_owned = x0 is None
     if x0 is None:
@@ -475,17 +519,10 @@ def block_cgls(Op, y: DistributedArray,
                 "block_cgls", [int(cd) for cd in np.asarray(status)],
                 iiter)
         else:
-            fn = _get_fused(Op, (id(Op), "block_cgls", niter, _vkey(y),
-                                 _vkey(x0)) + _mkey(M),
-                            lambda op: partial(_block_cgls_fused, op,
-                                               niter=niter, M=M),
-                            donate_argnums=_DONATE_X0, keepalive=M,
-                            aot_eligible=(M is None))
-            x, iiter, cost, cost1, kold = fn(
-                y, x0 if x0_owned else _donate_copy(x0), damp, tol)
-            iiter = int(iiter)
-            _metrics.inc("solver.block_cgls.solves")
-            _metrics.inc("solver.block_cgls.iterations", iiter)
+            x, iiter, cost, cost1, kold = _run_block_cgls_fused(
+                Op, y, x0, niter, damp, tol, M=M, x0_owned=x0_owned)
+            return (x, np.where(kold < tol, 1, 2), iiter, kold,
+                    cost1[-1], cost)
         kold = np.asarray(kold)
         istop = np.where(kold < tol, 1, 2)
         return (x, istop, iiter, kold,

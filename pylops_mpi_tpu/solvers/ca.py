@@ -485,7 +485,14 @@ def _pipe_loop(body, head, kold, aold, cost0, niter, tol, *, guards,
 def _pipe_cg_fused(Op, y, x0, tol, *, niter, M=None, guards=False,
                    stall_n=0, fault=None, block=False):
     """Whole pipelined (P)CG solve as one ``lax.while_loop`` — the CA
-    twin of ``basic._cg_fused`` (same return contract)."""
+    twin of ``basic._cg_fused`` (same return contract).
+
+    Also the autodiff tier's traced CA seam (autodiff/implicit.py):
+    under a non-``off`` CA mode, traced forward/backward solves inline
+    THIS builder for both ``pipelined`` and ``sstep`` — the s-step
+    engine's host-side breakdown fallback (``run_sstep_*``) cannot run
+    inside a trace, and the pipelined twin is its communication
+    equivalent (one fused reduction per iteration)."""
     head, kold, floors, aold, cost0 = _pipe_cg_seed(
         Op, y, x0, niter=niter, M=M, block=block)
     body = _make_pipe_body(Op.matvec, _vdtype(x0), floors, tol, M=M,

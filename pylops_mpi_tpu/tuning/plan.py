@@ -143,6 +143,12 @@ def _chip_kind() -> Tuple[str, str]:
 
 def plan_key(op: str, shape, dtype=None, n_dev: Optional[int] = None,
              axes=None, extra: Optional[Dict] = None) -> str:
+    """Canonical cache key for one tuned plan. Note for the autodiff
+    tier: the implicit backward solve (autodiff/implicit.py) runs the
+    SAME fused engine on the transposed system, so it deliberately
+    shares the forward solve's plan key — there is no ``|grad``
+    segment. A plan measured on the forward pass is optimal for its
+    backward pass too (same shapes, same collectives, same schedule)."""
     platform, chip = _chip_kind()
     try:
         dt = np.dtype(dtype).name if dtype is not None else "f32"

@@ -351,6 +351,15 @@ KNOBS = [
      "tier for programs the AOT bank does not serialize (closure "
      "operators, preconditioned solves, ISTA/FISTA); shared per CI "
      "job, rank-0-writes/others-read on multi-host"),
+    ("PYLOPS_MPI_TPU_AUTODIFF", "off|on", "off",
+     "utils/deps.py (solvers/basic.py, solvers/block.py, autodiff/*)",
+     "differentiable-solver tier: on lets traced (jax.grad/jvp) "
+     "inputs through cg/cgls/block_cg/block_cgls route to the "
+     "implicit-diff custom_vjp rules (autodiff/implicit.py) instead "
+     "of failing on the reverse-undifferentiable while_loop; off "
+     "(default) leaves every solver entry and lowered program "
+     "bit-identical — the explicit pylops_mpi_tpu.autodiff API "
+     "works regardless of the knob"),
 ]
 
 
@@ -438,6 +447,36 @@ def ca_mode() -> str:
             _warned_ca = True
         m = "off"
     return m
+
+
+_warned_autodiff = False
+
+
+def autodiff_mode() -> str:
+    """``PYLOPS_MPI_TPU_AUTODIFF`` resolved to ``off``/``on`` (unknown
+    values fall back to ``off`` with a one-time warning — a typo must
+    not silently change which solver entries accept tracers)."""
+    global _warned_autodiff
+    m = os.environ.get("PYLOPS_MPI_TPU_AUTODIFF", "off").strip().lower()
+    if m in ("", "none", "default", "0"):
+        m = "off"
+    if m in ("1", "true"):
+        m = "on"
+    if m not in ("off", "on"):
+        if not _warned_autodiff:
+            import warnings
+            warnings.warn(
+                f"PYLOPS_MPI_TPU_AUTODIFF={m!r} is not one of "
+                "['off', 'on']; using 'off'", stacklevel=2)
+            _warned_autodiff = True
+        m = "off"
+    return m
+
+
+def autodiff_enabled() -> bool:
+    """True when the differentiable-solver tier may reroute traced
+    solver inputs (see :func:`autodiff_mode`)."""
+    return autodiff_mode() == "on"
 
 
 def ca_s_default() -> int:
